@@ -1,0 +1,23 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+A brand-new JAX/XLA/Pallas implementation of the capabilities of
+Deeplearning4j (reference surveyed in SURVEY.md): typed JSON-serializable
+network configuration, sequential (MultiLayerNetwork) and DAG
+(ComputationGraph) containers, a full layer library, training
+infrastructure (updaters, LR schedules, listeners, evaluation, early
+stopping, transfer learning, checkpointing), and data-parallel training
+via XLA collectives over a `jax.sharding.Mesh` (replacing the reference's
+ParallelWrapper / Spark / Aeron parameter-server stack).
+
+Not a port: the reference's hand-written backprop and flattened parameter
+views (ref: deeplearning4j-nn/.../nn/multilayer/MultiLayerNetwork.java:440,1169)
+become pure functions under `jax.grad` and pytrees here.
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.nn.conf import (  # noqa: F401
+    NeuralNetConfiguration,
+    MultiLayerConfiguration,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: F401

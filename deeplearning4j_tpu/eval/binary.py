@@ -1,0 +1,81 @@
+"""Multi-label binary evaluation.
+
+Parity: eval/EvaluationBinary.java — per-output-column binary counts at a
+0.5 decision threshold, accuracy/precision/recall/F1 per column.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class EvaluationBinary:
+    def __init__(self, n_columns: Optional[int] = None, threshold: float = 0.5):
+        self.n = n_columns
+        self.threshold = threshold
+        self._initialized = False
+
+    def _ensure(self, n):
+        if not self._initialized:
+            self.n = self.n or n
+            self.tp = np.zeros(self.n, dtype=np.int64)
+            self.fp = np.zeros(self.n, dtype=np.int64)
+            self.tn = np.zeros(self.n, dtype=np.int64)
+            self.fn = np.zeros(self.n, dtype=np.int64)
+            self._initialized = True
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 3:
+            labels = labels.reshape(-1, labels.shape[-1])
+            predictions = predictions.reshape(-1, predictions.shape[-1])
+            if mask is not None:
+                m = np.asarray(mask).reshape(-1).astype(bool)
+                labels, predictions = labels[m], predictions[m]
+        self._ensure(labels.shape[-1])
+        pred = predictions >= self.threshold
+        actual = labels >= 0.5
+        self.tp += (pred & actual).sum(axis=0)
+        self.fp += (pred & ~actual).sum(axis=0)
+        self.tn += (~pred & ~actual).sum(axis=0)
+        self.fn += (~pred & actual).sum(axis=0)
+
+    def accuracy(self, col: int) -> float:
+        total = self.tp[col] + self.fp[col] + self.tn[col] + self.fn[col]
+        return float((self.tp[col] + self.tn[col]) / total) if total else 0.0
+
+    def precision(self, col: int) -> float:
+        d = self.tp[col] + self.fp[col]
+        return float(self.tp[col] / d) if d else 0.0
+
+    def recall(self, col: int) -> float:
+        d = self.tp[col] + self.fn[col]
+        return float(self.tp[col] / d) if d else 0.0
+
+    def f1(self, col: int) -> float:
+        p, r = self.precision(col), self.recall(col)
+        return 2 * p * r / (p + r) if (p + r) else 0.0
+
+    def average_accuracy(self) -> float:
+        return float(np.mean([self.accuracy(c) for c in range(self.n)]))
+
+    def stats(self) -> str:
+        lines = ["Column    Acc      Prec     Recall   F1"]
+        for c in range(self.n):
+            lines.append(
+                f"col_{c:<5} {self.accuracy(c):<8.4f} {self.precision(c):<8.4f} "
+                f"{self.recall(c):<8.4f} {self.f1(c):<8.4f}")
+        return "\n".join(lines)
+
+    def merge(self, other: "EvaluationBinary"):
+        if not getattr(other, "_initialized", False):
+            return self
+        self._ensure(other.n)
+        self.tp += other.tp
+        self.fp += other.fp
+        self.tn += other.tn
+        self.fn += other.fn
+        return self

@@ -1,0 +1,114 @@
+"""Regression evaluation: MSE, MAE, RMSE, RSE, PC (Pearson), R^2 per column.
+
+Parity: eval/RegressionEvaluation.java — accumulates sufficient statistics
+per output column across batches.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+
+class RegressionEvaluation:
+    def __init__(self, n_columns: Optional[int] = None,
+                 column_names: Optional[List[str]] = None):
+        self.column_names = column_names
+        if column_names is not None and n_columns is None:
+            n_columns = len(column_names)
+        self.n = n_columns
+        self._initialized = False
+
+    def _ensure(self, n):
+        if not self._initialized:
+            self.n = self.n or n
+            z = lambda: np.zeros(self.n)
+            self.count = z()
+            self.sum_abs_err = z()
+            self.sum_sq_err = z()
+            self.sum_label = z()
+            self.sum_label_sq = z()
+            self.sum_pred = z()
+            self.sum_pred_sq = z()
+            self.sum_label_pred = z()
+            self._initialized = True
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels, dtype=np.float64)
+        predictions = np.asarray(predictions, dtype=np.float64)
+        if labels.ndim == 3:
+            if mask is not None:
+                m = np.asarray(mask).reshape(-1).astype(bool)
+            else:
+                m = np.ones(labels.shape[0] * labels.shape[1], dtype=bool)
+            labels = labels.reshape(-1, labels.shape[-1])[m]
+            predictions = predictions.reshape(-1, predictions.shape[-1])[m]
+        self._ensure(labels.shape[-1])
+        err = predictions - labels
+        self.count += len(labels)
+        self.sum_abs_err += np.abs(err).sum(axis=0)
+        self.sum_sq_err += (err * err).sum(axis=0)
+        self.sum_label += labels.sum(axis=0)
+        self.sum_label_sq += (labels * labels).sum(axis=0)
+        self.sum_pred += predictions.sum(axis=0)
+        self.sum_pred_sq += (predictions * predictions).sum(axis=0)
+        self.sum_label_pred += (labels * predictions).sum(axis=0)
+
+    def mean_squared_error(self, col: int) -> float:
+        return float(self.sum_sq_err[col] / self.count[col])
+
+    def mean_absolute_error(self, col: int) -> float:
+        return float(self.sum_abs_err[col] / self.count[col])
+
+    def root_mean_squared_error(self, col: int) -> float:
+        return float(np.sqrt(self.mean_squared_error(col)))
+
+    def relative_squared_error(self, col: int) -> float:
+        n = self.count[col]
+        mean_label = self.sum_label[col] / n
+        denom = self.sum_label_sq[col] - n * mean_label**2
+        return float(self.sum_sq_err[col] / denom) if denom else float("inf")
+
+    def pearson_correlation(self, col: int) -> float:
+        n = self.count[col]
+        cov = self.sum_label_pred[col] - self.sum_label[col] * self.sum_pred[col] / n
+        var_l = self.sum_label_sq[col] - self.sum_label[col] ** 2 / n
+        var_p = self.sum_pred_sq[col] - self.sum_pred[col] ** 2 / n
+        denom = np.sqrt(var_l * var_p)
+        return float(cov / denom) if denom else 0.0
+
+    def r_squared(self, col: int) -> float:
+        n = self.count[col]
+        mean_label = self.sum_label[col] / n
+        ss_tot = self.sum_label_sq[col] - n * mean_label**2
+        return float(1.0 - self.sum_sq_err[col] / ss_tot) if ss_tot else 0.0
+
+    def average_mean_squared_error(self) -> float:
+        return float(np.mean([self.mean_squared_error(c) for c in range(self.n)]))
+
+    def average_mean_absolute_error(self) -> float:
+        return float(np.mean([self.mean_absolute_error(c) for c in range(self.n)]))
+
+    def stats(self) -> str:
+        lines = ["Column    MSE          MAE          RMSE         RSE          R^2"]
+        for c in range(self.n):
+            name = (self.column_names[c] if self.column_names
+                    else f"col_{c}")
+            lines.append(
+                f"{name:<9} {self.mean_squared_error(c):<12.5g} "
+                f"{self.mean_absolute_error(c):<12.5g} "
+                f"{self.root_mean_squared_error(c):<12.5g} "
+                f"{self.relative_squared_error(c):<12.5g} "
+                f"{self.r_squared(c):<12.5g}")
+        return "\n".join(lines)
+
+    def merge(self, other: "RegressionEvaluation"):
+        if not getattr(other, "_initialized", False):
+            return self
+        self._ensure(other.n)
+        for attr in ("count", "sum_abs_err", "sum_sq_err", "sum_label",
+                     "sum_label_sq", "sum_pred", "sum_pred_sq",
+                     "sum_label_pred"):
+            setattr(self, attr, getattr(self, attr) + getattr(other, attr))
+        return self

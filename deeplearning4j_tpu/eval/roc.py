@@ -1,0 +1,130 @@
+"""ROC / AUC evaluation.
+
+Parity: eval/ROC.java, ROCBinary.java, ROCMultiClass.java + eval/curves/.
+The reference uses `thresholdSteps` binning; we accumulate exact score
+histograms per batch with fixed bins (default 200 steps like the reference's
+default), giving O(bins) memory independent of dataset size.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+class _BinnedRoc:
+    """TPR/FPR via score binning in [0, 1]."""
+
+    def __init__(self, threshold_steps: int = 200):
+        self.bins = threshold_steps
+        self.pos_hist = np.zeros(self.bins, dtype=np.int64)
+        self.neg_hist = np.zeros(self.bins, dtype=np.int64)
+
+    def add(self, scores: np.ndarray, is_positive: np.ndarray):
+        idx = np.clip((scores * self.bins).astype(np.int64), 0, self.bins - 1)
+        np.add.at(self.pos_hist, idx[is_positive], 1)
+        np.add.at(self.neg_hist, idx[~is_positive], 1)
+
+    def curve(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Returns (fpr, tpr) arrays from highest threshold to lowest."""
+        # cumulate from the top bin down: predictions >= threshold
+        pos_cum = np.cumsum(self.pos_hist[::-1])
+        neg_cum = np.cumsum(self.neg_hist[::-1])
+        P = max(int(self.pos_hist.sum()), 1)
+        N = max(int(self.neg_hist.sum()), 1)
+        tpr = np.concatenate([[0.0], pos_cum / P])
+        fpr = np.concatenate([[0.0], neg_cum / N])
+        return fpr, tpr
+
+    def auc(self) -> float:
+        fpr, tpr = self.curve()
+        return float(np.trapezoid(tpr, fpr))
+
+
+class ROC:
+    """Binary-problem ROC: labels [N, 1] (0/1) or [N, 2] one-hot; scores are
+    P(class=1)."""
+
+    def __init__(self, threshold_steps: int = 200):
+        self._roc = _BinnedRoc(threshold_steps)
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 3:
+            labels = labels.reshape(-1, labels.shape[-1])
+            predictions = predictions.reshape(-1, predictions.shape[-1])
+            if mask is not None:
+                m = np.asarray(mask).reshape(-1).astype(bool)
+                labels, predictions = labels[m], predictions[m]
+        if labels.shape[-1] == 2:
+            pos = labels[:, 1] >= 0.5
+            score = predictions[:, 1]
+        else:
+            pos = labels[:, 0] >= 0.5
+            score = predictions[:, 0]
+        self._roc.add(score, pos)
+
+    def calculate_auc(self) -> float:
+        return self._roc.auc()
+
+    def get_roc_curve(self):
+        return self._roc.curve()
+
+
+class ROCBinary:
+    """Per-output-column ROC for multi-label binary outputs."""
+
+    def __init__(self, threshold_steps: int = 200):
+        self.steps = threshold_steps
+        self._rocs = None
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 3:
+            labels = labels.reshape(-1, labels.shape[-1])
+            predictions = predictions.reshape(-1, predictions.shape[-1])
+        if mask is not None:
+            m = np.asarray(mask).reshape(-1).astype(bool)
+            labels, predictions = labels[m], predictions[m]
+        if self._rocs is None:
+            self._rocs = [_BinnedRoc(self.steps) for _ in range(labels.shape[-1])]
+        for c, roc in enumerate(self._rocs):
+            roc.add(predictions[:, c], labels[:, c] >= 0.5)
+
+    def calculate_auc(self, col: int) -> float:
+        return self._rocs[col].auc()
+
+    def average_auc(self) -> float:
+        return float(np.mean([r.auc() for r in self._rocs]))
+
+
+class ROCMultiClass:
+    """One-vs-all ROC per class for softmax outputs."""
+
+    def __init__(self, threshold_steps: int = 200):
+        self.steps = threshold_steps
+        self._rocs = None
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 3:
+            labels = labels.reshape(-1, labels.shape[-1])
+            predictions = predictions.reshape(-1, predictions.shape[-1])
+        if mask is not None:
+            m = np.asarray(mask).reshape(-1).astype(bool)
+            labels, predictions = labels[m], predictions[m]
+        if self._rocs is None:
+            self._rocs = [_BinnedRoc(self.steps) for _ in range(labels.shape[-1])]
+        actual = labels.argmax(axis=-1)
+        for c, roc in enumerate(self._rocs):
+            roc.add(predictions[:, c], actual == c)
+
+    def calculate_auc(self, cls: int) -> float:
+        return self._rocs[cls].auc()
+
+    def average_auc(self) -> float:
+        return float(np.mean([r.auc() for r in self._rocs]))

@@ -1,0 +1,136 @@
+"""Activation functions.
+
+Mirrors the reference's activation set (ref: nd4j `Activation` enum consumed
+via `NeuralNetConfiguration.Builder.activation(...)`,
+deeplearning4j-nn/.../nn/conf/NeuralNetConfiguration.java:521-563). Each
+activation is a pure elementwise (or row-wise for softmax) JAX function, so
+XLA fuses it into the surrounding matmul/conv — no hand-written backprop
+(reference computes gradients by hand per layer; here `jax.grad` handles it).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-7
+
+
+def identity(x):
+    return x
+
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def relu6(x):
+    return jnp.minimum(jax.nn.relu(x), 6.0)
+
+
+def leakyrelu(x, alpha: float = 0.01):
+    return jax.nn.leaky_relu(x, negative_slope=alpha)
+
+
+def elu(x, alpha: float = 1.0):
+    return jax.nn.elu(x, alpha=alpha)
+
+
+def selu(x):
+    return jax.nn.selu(x)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def hardsigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def hardtanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+def rationaltanh(x):
+    # Padé-style rational approximation of tanh (cheap on VPU):
+    # 1.7159 * tanh(2x/3) approximated rationally.
+    a = jnp.abs(2.0 * x / 3.0)
+    approx = jnp.sign(x) * (1.0 - 1.0 / (1.0 + a + a * a + 1.41645 * a**4))
+    return 1.7159 * approx
+
+def rectifiedtanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+def softmax(x):
+    return jax.nn.softmax(x, axis=-1)
+
+
+def logsoftmax(x):
+    return jax.nn.log_softmax(x, axis=-1)
+
+
+def softplus(x):
+    return jax.nn.softplus(x)
+
+
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+def cube(x):
+    return x * x * x
+
+
+def swish(x):
+    return jax.nn.swish(x)
+
+
+def gelu(x):
+    return jax.nn.gelu(x)
+
+
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+ACTIVATIONS = {
+    "identity": identity,
+    "linear": identity,
+    "relu": relu,
+    "relu6": relu6,
+    "leakyrelu": leakyrelu,
+    "elu": elu,
+    "selu": selu,
+    "sigmoid": sigmoid,
+    "hardsigmoid": hardsigmoid,
+    "tanh": tanh,
+    "hardtanh": hardtanh,
+    "rationaltanh": rationaltanh,
+    "rectifiedtanh": rectifiedtanh,
+    "softmax": softmax,
+    "logsoftmax": logsoftmax,
+    "softplus": softplus,
+    "softsign": softsign,
+    "cube": cube,
+    "swish": swish,
+    "gelu": gelu,
+    "mish": mish,
+}
+
+
+def get_activation(name):
+    """Resolve an activation by name (case-insensitive) or pass callables through."""
+    if callable(name):
+        return name
+    key = str(name).lower()
+    if key not in ACTIVATIONS:
+        raise ValueError(
+            f"Unknown activation '{name}'. Known: {sorted(ACTIVATIONS)}"
+        )
+    return ACTIVATIONS[key]

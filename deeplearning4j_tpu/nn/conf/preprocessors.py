@@ -1,0 +1,212 @@
+"""InputPreProcessors: shape adapters inserted between layer families.
+
+Parity: nn/conf/preprocessor/ (CnnToFeedForwardPreProcessor,
+FeedForwardToCnnPreProcessor, RnnToFeedForwardPreProcessor, …) and the
+auto-insertion logic in nn/conf/layers/setup/. Here each preprocessor is a
+pure reshape/transpose; the backward direction is derived by autodiff, so
+only the forward transform + static shape math exist.
+
+Layouts: conv NHWC, recurrent [B, T, C] (see nn/conf/inputs.py docstring).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import (
+    InputType,
+    InputTypeConvolutional,
+    InputTypeConvolutionalFlat,
+    InputTypeFeedForward,
+    InputTypeRecurrent,
+)
+
+
+class InputPreProcessor:
+    def preprocess(self, x):
+        raise NotImplementedError
+
+    def output_type(self, input_type: InputType) -> InputType:
+        raise NotImplementedError
+
+    def feed_forward_mask(self, mask, input_type):
+        return mask
+
+    def to_dict(self) -> dict:
+        d = {"type": type(self).__name__}
+        d.update(self.__dict__)
+        return d
+
+
+@dataclass(frozen=True)
+class CnnToFeedForwardPreProcessor(InputPreProcessor):
+    """[B, H, W, C] -> [B, H*W*C]."""
+
+    height: int
+    width: int
+    channels: int
+
+    def preprocess(self, x):
+        return x.reshape(x.shape[0], -1)
+
+    def output_type(self, input_type):
+        return InputType.feed_forward(self.height * self.width * self.channels)
+
+
+@dataclass(frozen=True)
+class FeedForwardToCnnPreProcessor(InputPreProcessor):
+    """[B, H*W*C] -> [B, H, W, C]. Also accepts already-4D input unchanged."""
+
+    height: int
+    width: int
+    channels: int
+
+    def preprocess(self, x):
+        if x.ndim == 4:
+            return x
+        return x.reshape(x.shape[0], self.height, self.width, self.channels)
+
+    def output_type(self, input_type):
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+@dataclass(frozen=True)
+class RnnToFeedForwardPreProcessor(InputPreProcessor):
+    """[B, T, C] -> [B*T, C] (per-timestep dense processing)."""
+
+    def preprocess(self, x):
+        return x.reshape(-1, x.shape[-1])
+
+    def output_type(self, input_type):
+        return InputType.feed_forward(input_type.size)
+
+    def feed_forward_mask(self, mask, input_type):
+        return None if mask is None else mask.reshape(-1)
+
+
+@dataclass(frozen=True)
+class FeedForwardToRnnPreProcessor(InputPreProcessor):
+    """[B*T, C] -> [B, T, C]; needs static T."""
+
+    timeseries_length: int
+
+    def preprocess(self, x):
+        return x.reshape(-1, self.timeseries_length, x.shape[-1])
+
+    def output_type(self, input_type):
+        return InputType.recurrent(input_type.size, self.timeseries_length)
+
+    def feed_forward_mask(self, mask, input_type):
+        return None if mask is None else mask.reshape(-1, self.timeseries_length)
+
+
+@dataclass(frozen=True)
+class CnnToRnnPreProcessor(InputPreProcessor):
+    """[B, H, W, C] -> [B, T=H, C*W]: rows become timesteps (reference uses
+    this for image-to-sequence models)."""
+
+    height: int
+    width: int
+    channels: int
+
+    def preprocess(self, x):
+        B, H, W, C = x.shape
+        return x.reshape(B, H, W * C)
+
+    def output_type(self, input_type):
+        return InputType.recurrent(self.width * self.channels, self.height)
+
+
+@dataclass(frozen=True)
+class RnnToCnnPreProcessor(InputPreProcessor):
+    """[B, T, C] -> [B*T, H, W, C'] with H*W*C' == C."""
+
+    height: int
+    width: int
+    channels: int
+
+    def preprocess(self, x):
+        return x.reshape(-1, self.height, self.width, self.channels)
+
+    def output_type(self, input_type):
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+    def feed_forward_mask(self, mask, input_type):
+        return None if mask is None else mask.reshape(-1)
+
+
+PREPROCESSORS = {
+    c.__name__: c
+    for c in [
+        CnnToFeedForwardPreProcessor,
+        FeedForwardToCnnPreProcessor,
+        RnnToFeedForwardPreProcessor,
+        FeedForwardToRnnPreProcessor,
+        CnnToRnnPreProcessor,
+        RnnToCnnPreProcessor,
+    ]
+}
+
+
+def preprocessor_from_dict(d: dict) -> InputPreProcessor:
+    d = dict(d)
+    kind = d.pop("type")
+    return PREPROCESSORS[kind](**d)
+
+
+def infer_preprocessor(prev_type: InputType, layer) -> InputPreProcessor | None:
+    """Auto-insert the right adapter between layer families.
+
+    Mirrors the reference's automatic preprocessor insertion
+    (nn/conf/layers/setup/, driven from MultiLayerConfiguration.Builder
+    setInputType). Rules:
+      convolutionalFlat input + conv/subsampling layer -> unflatten to NHWC
+      convolutional output + dense/output layer        -> flatten
+      recurrent output + dense layer                   -> per-timestep is
+                                                          native (no op)
+    """
+    from deeplearning4j_tpu.nn.layers.conv import (
+        ConvolutionLayer,
+        SubsamplingLayer,
+        ZeroPaddingLayer,
+        LocalResponseNormalization,
+    )
+    from deeplearning4j_tpu.nn.layers.core import (
+        DenseLayer,
+        OutputLayer,
+        EmbeddingLayer,
+    )
+    from deeplearning4j_tpu.nn.layers.norm import BatchNormalization
+    from deeplearning4j_tpu.nn.layers.recurrent import (
+        LSTM,
+        GravesBidirectionalLSTM,
+    )
+
+    conv_like = (ConvolutionLayer, SubsamplingLayer, ZeroPaddingLayer,
+                 LocalResponseNormalization)
+    ff_like = (DenseLayer, OutputLayer, EmbeddingLayer)
+    rnn_like = (LSTM, GravesBidirectionalLSTM)
+
+    if isinstance(prev_type, InputTypeConvolutionalFlat):
+        if isinstance(layer, conv_like) or isinstance(layer, BatchNormalization):
+            return FeedForwardToCnnPreProcessor(
+                prev_type.height, prev_type.width, prev_type.channels)
+        return None  # dense layers consume the flat view directly
+    if isinstance(prev_type, InputTypeConvolutional):
+        if isinstance(layer, ff_like):
+            return CnnToFeedForwardPreProcessor(
+                prev_type.height, prev_type.width, prev_type.channels)
+        if isinstance(layer, rnn_like):
+            return CnnToRnnPreProcessor(
+                prev_type.height, prev_type.width, prev_type.channels)
+        return None
+    if isinstance(prev_type, InputTypeFeedForward):
+        if isinstance(layer, rnn_like):
+            raise ValueError(
+                "Cannot feed feed-forward activations into a recurrent layer "
+                "without a FeedForwardToRnnPreProcessor with explicit length"
+            )
+        return None
+    return None

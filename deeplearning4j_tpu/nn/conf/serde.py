@@ -1,0 +1,53 @@
+"""Layer config serde: class registry + dict round-trip.
+
+Parity: the reference's Jackson polymorphic-subtype JSON
+(NeuralNetConfiguration.java:322 toJson / :339 fromJson) including support
+for registering custom third-party layers (tested by the reference at
+deeplearning4j-core/src/test/.../nn/layers/custom/). Register a custom layer
+with `register_layer(cls)` and it round-trips like a built-in.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Type
+
+from deeplearning4j_tpu.nn.layers.base import Layer
+
+_LAYER_REGISTRY: Dict[str, Type[Layer]] = {}
+
+
+def register_layer(cls: Type[Layer]) -> Type[Layer]:
+    """Register a Layer subclass for JSON round-trip (usable as a decorator)."""
+    _LAYER_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def _register_builtins():
+    from deeplearning4j_tpu.nn import layers as L
+
+    for name in L.__dict__.values():
+        if isinstance(name, type) and issubclass(name, Layer):
+            _LAYER_REGISTRY.setdefault(name.__name__, name)
+
+
+def layer_from_dict(d: dict) -> Layer:
+    _register_builtins()
+    d = dict(d)
+    kind = d.pop("type")
+    if kind not in _LAYER_REGISTRY:
+        raise ValueError(
+            f"Unknown layer type '{kind}'. Registered: {sorted(_LAYER_REGISTRY)}. "
+            "Custom layers must call register_layer(cls) before deserialization."
+        )
+    cls = _LAYER_REGISTRY[kind]
+    field_names = {f.name for f in dataclasses.fields(cls)}
+    # tolerate forward-compat extra keys, convert lists back to tuples
+    kwargs = {}
+    for k, v in d.items():
+        if k not in field_names:
+            continue
+        if isinstance(v, list):
+            v = tuple(v)
+        kwargs[k] = v
+    return cls(**kwargs)

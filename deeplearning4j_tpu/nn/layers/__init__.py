@@ -1,0 +1,27 @@
+from deeplearning4j_tpu.nn.layers.base import Layer, BaseLayer  # noqa: F401
+from deeplearning4j_tpu.nn.layers.core import (  # noqa: F401
+    DenseLayer,
+    ActivationLayer,
+    DropoutLayer,
+    EmbeddingLayer,
+    OutputLayer,
+    RnnOutputLayer,
+    LossLayer,
+    GlobalPoolingLayer,
+)
+from deeplearning4j_tpu.nn.layers.conv import (  # noqa: F401
+    ConvolutionLayer,
+    Convolution1DLayer,
+    SubsamplingLayer,
+    Subsampling1DLayer,
+    ZeroPaddingLayer,
+    LocalResponseNormalization,
+)
+from deeplearning4j_tpu.nn.layers.norm import BatchNormalization  # noqa: F401
+from deeplearning4j_tpu.nn.layers.recurrent import (  # noqa: F401
+    LSTM,
+    GravesLSTM,
+    GravesBidirectionalLSTM,
+)
+from deeplearning4j_tpu.nn.layers.variational import VariationalAutoencoder  # noqa: F401
+from deeplearning4j_tpu.nn.layers.feedforward import AutoEncoder  # noqa: F401

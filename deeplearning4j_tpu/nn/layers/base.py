@@ -1,0 +1,141 @@
+"""Layer base classes.
+
+Design: the reference splits each layer into a *config* class
+(nn/conf/layers/*.java) and an *implementation* class (nn/layers/*.java)
+with hand-written `activate`/`backpropGradient` (ref: nn/api/Layer.java:119,202).
+In a functional JAX framework that split disappears: a layer is a frozen
+dataclass of hyperparameters carrying two pure functions —
+`init_params(key, input_type) -> pytree` and
+`apply(params, x, ...) -> (y, state)` — and the backward pass is derived by
+`jax.grad` over the whole network. Shape inference (`output_type`) mirrors
+the reference's InputType propagation (nn/conf/inputs/InputType.java:62-94).
+
+Mutable per-layer state (BatchNorm running stats, RNN carry for streaming
+inference) lives in a separate `state` pytree threaded through `apply`,
+keeping params/state separation explicit for `jax.grad`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+
+@dataclass(kw_only=True)
+class Layer:
+    """Base hyperparameter container for all layers.
+
+    Fields set to None inherit the network-level default from
+    NeuralNetConfiguration at build() time (mirroring the reference's
+    global-config → per-layer override flow,
+    NeuralNetConfiguration.java:521-563).
+    """
+
+    name: Optional[str] = None
+    # None = inherit the global NeuralNetConfiguration default at build()
+    dropout: Optional[float] = None  # inverted dropout on layer *input* in training
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    updater: Optional[str] = None          # per-layer updater override
+    learning_rate: Optional[float] = None  # per-layer LR override
+    bias_learning_rate: Optional[float] = None
+
+    # ---- shape inference ----
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def set_n_in(self, input_type: InputType) -> None:
+        """Infer nIn from the incoming InputType (no-op for param-free layers)."""
+
+    # ---- parameters ----
+    def init_params(self, key, input_type: InputType, dtype=jnp.float32) -> Dict[str, Any]:
+        return {}
+
+    def init_state(self, input_type: InputType, dtype=jnp.float32) -> Dict[str, Any]:
+        return {}
+
+    def has_params(self) -> bool:
+        return False
+
+    # ---- forward ----
+    def apply(self, params, x, *, train: bool = False, rng=None, state=None, mask=None):
+        """Returns (output, new_state)."""
+        raise NotImplementedError
+
+    # ---- masking ----
+    def feed_forward_mask(self, mask, input_type: InputType):
+        """Propagate a [batch] or [batch, time] mask through this layer
+        (ref: nn/api/Layer.java:309 feedForwardMaskArray)."""
+        return mask
+
+    # ---- regularization ----
+    def regularization_loss(self, params) -> jnp.ndarray:
+        """L1/L2 penalty over this layer's weight (non-bias) params."""
+        l1 = self.l1 or 0.0
+        l2 = self.l2 or 0.0
+        if not params or (l1 == 0.0 and l2 == 0.0):
+            return jnp.asarray(0.0)
+        reg = 0.0
+        # Walk leaves with their paths so nested param dicts (BiLSTM fwd/bwd,
+        # VAE sub-nets) are handled: a leaf is a bias iff its own dict key
+        # starts with 'b' (b, vb, beta, ...); biases are exempt per the
+        # reference default.
+        leaves_with_path = jax.tree_util.tree_flatten_with_path(params)[0]
+        for path, leaf in leaves_with_path:
+            last = path[-1]
+            key_name = getattr(last, "key", None) or getattr(last, "name", "")
+            if str(key_name).startswith("b"):
+                continue
+            if l2:
+                reg = reg + 0.5 * l2 * jnp.sum(leaf * leaf)
+            if l1:
+                reg = reg + l1 * jnp.sum(jnp.abs(leaf))
+        return jnp.asarray(reg)
+
+    # ---- input dropout (shared by all layers) ----
+    def _maybe_dropout_input(self, x, train, rng):
+        if not train or not self.dropout or self.dropout <= 0.0:
+            return x
+        if rng is None:
+            raise ValueError(
+                f"Layer {self.name or type(self).__name__} has dropout but no rng"
+            )
+        keep = 1.0 - self.dropout
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0)
+
+    # ---- serde ----
+    def to_dict(self) -> dict:
+        d = {"type": type(self).__name__}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if isinstance(v, InputType):
+                v = v.to_dict()
+            d[f.name] = v
+        return d
+
+    def clone(self, **overrides) -> "Layer":
+        return dataclasses.replace(self, **overrides)
+
+
+@dataclass(kw_only=True)
+class BaseLayer(Layer):
+    """Base for layers with weights + an activation (dense/conv/rnn families)."""
+
+    n_in: Optional[int] = None
+    n_out: Optional[int] = None
+    # None = inherit global default (activation: sigmoid, weight_init: xavier);
+    # subclasses with a strong convention override the class default
+    # (OutputLayer: softmax, LSTM: tanh) and explicit user values always win.
+    activation: Optional[str] = None
+    weight_init: Optional[str] = None
+    bias_init: float = 0.0
+
+    def has_params(self) -> bool:
+        return True

@@ -1,0 +1,291 @@
+"""Convolutional layers: Convolution2D/1D, Subsampling (pooling), ZeroPadding,
+LocalResponseNormalization.
+
+Parity targets (reference):
+- ConvolutionLayer: nn/conf/layers/ConvolutionLayer.java +
+  nn/layers/convolution/ConvolutionLayer.java (cuDNN helper hook at :74-84)
+- SubsamplingLayer: nn/layers/convolution/subsampling/SubsamplingLayer.java
+- LRN: nn/layers/normalization/LocalResponseNormalization.java
+
+TPU-first design: the reference's cuDNN helper tier (algorithm selection
+GEMM/FFT/Winograd, CudnnConvolutionHelper.java:151-210) is unnecessary —
+`lax.conv_general_dilated` in NHWC/HWIO layout lowers to MXU-tiled convs and
+XLA picks the algorithm. Padding modes follow the reference's ConvolutionMode
+(truncate/same) as static shape math.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf.inputs import (
+    InputType,
+    InputTypeConvolutional,
+    InputTypeRecurrent,
+)
+from deeplearning4j_tpu.nn.layers.base import BaseLayer, Layer
+from deeplearning4j_tpu.nn.weights import init_weights
+
+_DIMS_NHWC = ("NHWC", "HWIO", "NHWC")
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (tuple, list)):
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
+
+
+def _out_dim(size, k, s, pad, mode):
+    if mode == "same":
+        return -(-size // s)  # ceil
+    return (size + 2 * pad - k) // s + 1
+
+
+def _explicit_padding(mode, pad):
+    """Return lax-style padding config for one spatial dim."""
+    return pad  # numeric pads handled by caller; 'same' uses lax SAME
+
+
+@dataclass(kw_only=True)
+class ConvolutionLayer(BaseLayer):
+    """2D convolution over NHWC input. kernel/stride/padding are (h, w) pairs.
+
+    convolution_mode: 'truncate' (explicit padding, floor division — reference
+    default) or 'same' (SAME padding, stride-ceil output).
+    """
+
+    kernel_size: Sequence[int] = (5, 5)
+    stride: Sequence[int] = (1, 1)
+    padding: Sequence[int] = (0, 0)
+    convolution_mode: str = "truncate"
+    activation: Optional[str] = "identity"
+    dilation: Sequence[int] = (1, 1)
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if not isinstance(input_type, InputTypeConvolutional):
+            raise ValueError(f"ConvolutionLayer needs CNN input, got {input_type}")
+        self.n_in = input_type.channels
+
+    def output_type(self, input_type: InputType) -> InputType:
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.padding)
+        mode = self.convolution_mode
+        h = _out_dim(input_type.height, kh, sh, ph, mode)
+        w = _out_dim(input_type.width, kw, sw, pw, mode)
+        if h <= 0 or w <= 0:
+            raise ValueError(
+                f"Invalid conv output {h}x{w} from {input_type} with "
+                f"k={self.kernel_size} s={self.stride} p={self.padding}"
+            )
+        return InputType.convolutional(h, w, self.n_out)
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        kh, kw = _pair(self.kernel_size)
+        fan_in = self.n_in * kh * kw
+        fan_out = self.n_out * kh * kw
+        W = init_weights(
+            self.weight_init, key, (kh, kw, self.n_in, self.n_out),
+            fan_in=fan_in, fan_out=fan_out, dtype=dtype,
+        )
+        b = jnp.full((self.n_out,), self.bias_init, dtype)
+        return {"W": W, "b": b}
+
+    def apply(self, params, x, *, train=False, rng=None, state=None, mask=None):
+        x = self._maybe_dropout_input(x, train, rng)
+        sh, sw = _pair(self.stride)
+        dh, dw = _pair(self.dilation)
+        if self.convolution_mode == "same":
+            padding = "SAME"
+        else:
+            ph, pw = _pair(self.padding)
+            padding = ((ph, ph), (pw, pw))
+        y = lax.conv_general_dilated(
+            x, params["W"],
+            window_strides=(sh, sw),
+            padding=padding,
+            rhs_dilation=(dh, dw),
+            dimension_numbers=_DIMS_NHWC,
+        )
+        y = y + params["b"]
+        return get_activation(self.activation)(y), state
+
+
+@dataclass(kw_only=True)
+class Convolution1DLayer(BaseLayer):
+    """1D convolution over [B, T, C] (recurrent-typed) input."""
+
+    kernel_size: int = 3
+    stride: int = 1
+    padding: int = 0
+    convolution_mode: str = "same"
+    activation: Optional[str] = "identity"
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if not isinstance(input_type, InputTypeRecurrent):
+            raise ValueError(f"Convolution1D needs recurrent input, got {input_type}")
+        self.n_in = input_type.size
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t = input_type.timeseries_length
+        if t is not None:
+            t = _out_dim(t, self.kernel_size, self.stride, self.padding,
+                         self.convolution_mode)
+        return InputType.recurrent(self.n_out, t)
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        fan_in = self.n_in * self.kernel_size
+        fan_out = self.n_out * self.kernel_size
+        W = init_weights(
+            self.weight_init, key, (self.kernel_size, self.n_in, self.n_out),
+            fan_in=fan_in, fan_out=fan_out, dtype=dtype,
+        )
+        b = jnp.full((self.n_out,), self.bias_init, dtype)
+        return {"W": W, "b": b}
+
+    def apply(self, params, x, *, train=False, rng=None, state=None, mask=None):
+        x = self._maybe_dropout_input(x, train, rng)
+        if self.convolution_mode == "same":
+            padding = "SAME"
+        else:
+            padding = ((self.padding, self.padding),)
+        y = lax.conv_general_dilated(
+            x, params["W"],
+            window_strides=(self.stride,),
+            padding=padding,
+            dimension_numbers=("NHC", "HIO", "NHC"),
+        )
+        return get_activation(self.activation)(y + params["b"]), state
+
+
+@dataclass(kw_only=True)
+class SubsamplingLayer(Layer):
+    """Spatial pooling (max/avg/pnorm/sum) over NHWC input via reduce_window."""
+
+    pooling_type: str = "max"
+    kernel_size: Sequence[int] = (2, 2)
+    stride: Sequence[int] = (2, 2)
+    padding: Sequence[int] = (0, 0)
+    convolution_mode: str = "truncate"
+    pnorm: int = 2
+
+    def output_type(self, input_type: InputType) -> InputType:
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.padding)
+        h = _out_dim(input_type.height, kh, sh, ph, self.convolution_mode)
+        w = _out_dim(input_type.width, kw, sw, pw, self.convolution_mode)
+        return InputType.convolutional(h, w, input_type.channels)
+
+    def _padding_config(self):
+        if self.convolution_mode == "same":
+            return "SAME"
+        ph, pw = _pair(self.padding)
+        return ((0, 0), (ph, ph), (pw, pw), (0, 0))
+
+    def apply(self, params, x, *, train=False, rng=None, state=None, mask=None):
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        window = (1, kh, kw, 1)
+        strides = (1, sh, sw, 1)
+        pad = self._padding_config()
+        pt = self.pooling_type.lower()
+        if pt == "max":
+            y = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pad)
+        elif pt in ("avg", "sum"):
+            y = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
+            if pt == "avg":
+                y = y / (kh * kw)
+        elif pt == "pnorm":
+            p = float(self.pnorm)
+            y = lax.reduce_window(
+                jnp.abs(x) ** p, 0.0, lax.add, window, strides, pad
+            ) ** (1.0 / p)
+        else:
+            raise ValueError(f"Unknown pooling type {self.pooling_type}")
+        return y, state
+
+
+@dataclass(kw_only=True)
+class Subsampling1DLayer(Layer):
+    """Temporal pooling over [B, T, C]."""
+
+    pooling_type: str = "max"
+    kernel_size: int = 2
+    stride: int = 2
+    padding: int = 0
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t = input_type.timeseries_length
+        if t is not None:
+            t = _out_dim(t, self.kernel_size, self.stride, self.padding, "truncate")
+        return InputType.recurrent(input_type.size, t)
+
+    def apply(self, params, x, *, train=False, rng=None, state=None, mask=None):
+        window = (1, self.kernel_size, 1)
+        strides = (1, self.stride, 1)
+        pad = ((0, 0), (self.padding, self.padding), (0, 0))
+        if self.pooling_type.lower() == "max":
+            y = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pad)
+        else:
+            y = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
+            if self.pooling_type.lower() == "avg":
+                y = y / self.kernel_size
+        return y, state
+
+
+@dataclass(kw_only=True)
+class ZeroPaddingLayer(Layer):
+    """Zero-pads spatial dims of NHWC input. padding = (top, bottom, left, right)
+    or (h, w) symmetric."""
+
+    padding: Sequence[int] = (1, 1)
+
+    def _pads(self):
+        p = tuple(int(v) for v in self.padding)
+        if len(p) == 2:
+            return (p[0], p[0], p[1], p[1])
+        if len(p) == 4:
+            return p
+        raise ValueError(f"padding must have 2 or 4 elements, got {p}")
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t, b, l, r = self._pads()
+        return InputType.convolutional(
+            input_type.height + t + b, input_type.width + l + r,
+            input_type.channels,
+        )
+
+    def apply(self, params, x, *, train=False, rng=None, state=None, mask=None):
+        t, b, l, r = self._pads()
+        return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0))), state
+
+
+@dataclass(kw_only=True)
+class LocalResponseNormalization(Layer):
+    """Cross-channel LRN: x / (k + alpha*sum_window(x^2))^beta over NHWC.
+
+    On TPU this is a channel-axis reduce_window — elementwise-heavy and
+    bandwidth-bound, fused by XLA.
+    """
+
+    k: float = 2.0
+    n: int = 5
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    def apply(self, params, x, *, train=False, rng=None, state=None, mask=None):
+        half = self.n // 2
+        sq = x * x
+        window = (1, 1, 1, self.n)
+        strides = (1, 1, 1, 1)
+        pad = ((0, 0), (0, 0), (0, 0), (half, half))
+        ssum = lax.reduce_window(sq, 0.0, lax.add, window, strides, pad)
+        denom = (self.k + self.alpha * ssum) ** self.beta
+        return x / denom, state

@@ -1,0 +1,259 @@
+"""Core feed-forward layers: Dense, Activation, Dropout, Embedding,
+Output/RnnOutput/Loss, GlobalPooling.
+
+Parity targets (reference):
+- DenseLayer: nn/conf/layers/DenseLayer.java + nn/layers/feedforward/dense/
+- OutputLayer: nn/conf/layers/OutputLayer.java; score at
+  MultiLayerNetwork.java:2138 (loss mean over minibatch + l1/l2 terms)
+- EmbeddingLayer: nn/conf/layers/EmbeddingLayer.java (integer-index lookup)
+- GlobalPoolingLayer: nn/conf/layers/GlobalPoolingLayer.java (mask-aware
+  pooling over time or spatial dims)
+
+TPU notes: Dense is a single [B, nIn] x [nIn, nOut] matmul — kept bf16-friendly
+and large so XLA tiles it onto the MXU; the activation fuses into the matmul
+epilogue. Embedding lookup is `take` (gather), which XLA lowers efficiently;
+no sparse-update machinery is needed because gradients flow through gather's
+transpose (scatter-add) automatically.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf.inputs import (
+    InputType,
+    InputTypeConvolutional,
+    InputTypeFeedForward,
+    InputTypeRecurrent,
+)
+from deeplearning4j_tpu.nn.layers.base import BaseLayer, Layer
+from deeplearning4j_tpu.nn.losses import get_loss
+from deeplearning4j_tpu.nn.weights import init_weights
+
+
+@dataclass(kw_only=True)
+class DenseLayer(BaseLayer):
+    """Fully connected layer: y = act(x @ W + b)."""
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if isinstance(input_type, InputTypeFeedForward):
+            self.n_in = input_type.size
+        elif isinstance(input_type, InputTypeRecurrent):
+            # Dense applied per-timestep over [B, T, C]
+            self.n_in = input_type.size
+        else:
+            raise ValueError(
+                f"DenseLayer needs feed-forward input, got {input_type}"
+            )
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if isinstance(input_type, InputTypeRecurrent):
+            return InputType.recurrent(self.n_out, input_type.timeseries_length)
+        return InputType.feed_forward(self.n_out)
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        wkey, _ = jax.random.split(key)
+        W = init_weights(
+            self.weight_init, wkey, (self.n_in, self.n_out),
+            fan_in=self.n_in, fan_out=self.n_out, dtype=dtype,
+        )
+        b = jnp.full((self.n_out,), self.bias_init, dtype)
+        return {"W": W, "b": b}
+
+    def apply(self, params, x, *, train=False, rng=None, state=None, mask=None):
+        x = self._maybe_dropout_input(x, train, rng)
+        y = x @ params["W"] + params["b"]
+        return get_activation(self.activation)(y), state
+
+
+@dataclass(kw_only=True)
+class ActivationLayer(Layer):
+    """Applies an activation function elementwise (no params)."""
+
+    activation: str = "relu"
+
+    def apply(self, params, x, *, train=False, rng=None, state=None, mask=None):
+        return get_activation(self.activation)(x), state
+
+
+@dataclass(kw_only=True)
+class DropoutLayer(Layer):
+    """Standalone inverted-dropout layer (identity at inference)."""
+
+    dropout: float = 0.5
+
+    def apply(self, params, x, *, train=False, rng=None, state=None, mask=None):
+        return self._maybe_dropout_input(x, train, rng), state
+
+
+@dataclass(kw_only=True)
+class EmbeddingLayer(BaseLayer):
+    """Lookup-table layer: integer indices [B] or [B,1] -> vectors [B, nOut].
+
+    Reference equivalent feeds one-hot through a weight matrix; on TPU a
+    gather is strictly better (no materialized one-hot).
+    """
+
+    activation: Optional[str] = "identity"
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if isinstance(input_type, InputTypeFeedForward):
+            self.n_in = input_type.size
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        W = init_weights(
+            self.weight_init, key, (self.n_in, self.n_out),
+            fan_in=self.n_in, fan_out=self.n_out, dtype=dtype,
+        )
+        b = jnp.full((self.n_out,), self.bias_init, dtype)
+        return {"W": W, "b": b}
+
+    def apply(self, params, x, *, train=False, rng=None, state=None, mask=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 2 and idx.shape[-1] == 1:
+            idx = idx[:, 0]
+        y = jnp.take(params["W"], idx, axis=0) + params["b"]
+        return get_activation(self.activation)(y), state
+
+
+@dataclass(kw_only=True)
+class BaseOutputLayer(BaseLayer):
+    """Shared logic for output layers: loss computation over pre-activations."""
+
+    loss: str = "mcxent"
+    activation: Optional[str] = "softmax"
+
+    def compute_per_example_loss(self, labels, pre_output, mask=None):
+        return get_loss(self.loss)(labels, pre_output, self.activation, mask)
+
+    def pre_output(self, params, x):
+        return x @ params["W"] + params["b"]
+
+
+@dataclass(kw_only=True)
+class OutputLayer(BaseOutputLayer):
+    """Dense + loss head for classification/regression."""
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if isinstance(input_type, InputTypeRecurrent):
+            raise ValueError(
+                "OutputLayer got recurrent [B, T, C] input; use RnnOutputLayer "
+                "for per-timestep outputs, or insert a "
+                "RnnToFeedForwardPreProcessor / GlobalPoolingLayer first"
+            )
+        if isinstance(input_type, InputTypeFeedForward):
+            self.n_in = input_type.size
+        else:
+            raise ValueError(f"OutputLayer needs flat input, got {input_type}")
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    init_params = DenseLayer.init_params
+
+    def apply(self, params, x, *, train=False, rng=None, state=None, mask=None):
+        x = self._maybe_dropout_input(x, train, rng)
+        return get_activation(self.activation)(self.pre_output(params, x)), state
+
+
+@dataclass(kw_only=True)
+class RnnOutputLayer(BaseOutputLayer):
+    """Per-timestep output head over [B, T, C] activations."""
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if isinstance(input_type, InputTypeRecurrent):
+            self.n_in = input_type.size
+        else:
+            raise ValueError(f"RnnOutputLayer needs recurrent input, got {input_type}")
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, getattr(input_type, "timeseries_length", None))
+
+    init_params = DenseLayer.init_params
+
+    def apply(self, params, x, *, train=False, rng=None, state=None, mask=None):
+        x = self._maybe_dropout_input(x, train, rng)
+        return get_activation(self.activation)(self.pre_output(params, x)), state
+
+
+@dataclass(kw_only=True)
+class LossLayer(BaseOutputLayer):
+    """Loss-only head: no weights, input passes straight to the loss
+    (ref: nn/conf/layers/LossLayer.java)."""
+
+    activation: Optional[str] = "identity"
+
+    def has_params(self) -> bool:
+        return False
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        return {}
+
+    def pre_output(self, params, x):
+        return x
+
+    def apply(self, params, x, *, train=False, rng=None, state=None, mask=None):
+        return get_activation(self.activation)(x), state
+
+
+@dataclass(kw_only=True)
+class GlobalPoolingLayer(Layer):
+    """Mask-aware global pooling over time ([B,T,C] -> [B,C]) or spatial dims
+    ([B,H,W,C] -> [B,C]). pooling_type: max | avg | sum | pnorm."""
+
+    pooling_type: str = "max"
+    pnorm: int = 2
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if isinstance(input_type, InputTypeRecurrent):
+            return InputType.feed_forward(input_type.size)
+        if isinstance(input_type, InputTypeConvolutional):
+            return InputType.feed_forward(input_type.channels)
+        return input_type
+
+    def apply(self, params, x, *, train=False, rng=None, state=None, mask=None):
+        if x.ndim == 3:
+            axes = (1,)
+        elif x.ndim == 4:
+            axes = (1, 2)
+        else:
+            raise ValueError(f"GlobalPooling needs rank 3 or 4 input, got {x.shape}")
+
+        pt = self.pooling_type.lower()
+        if mask is not None and x.ndim == 3:
+            m = mask[..., None]
+            if pt == "max":
+                x = jnp.where(m > 0, x, -jnp.inf)
+            else:
+                x = x * m
+            count = jnp.maximum(jnp.sum(mask, axis=1, keepdims=True), 1.0)
+        else:
+            count = None
+
+        if pt == "max":
+            return jnp.max(x, axis=axes), state
+        if pt == "sum":
+            return jnp.sum(x, axis=axes), state
+        if pt == "avg":
+            s = jnp.sum(x, axis=axes)
+            if count is not None:
+                return s / count, state
+            denom = 1.0
+            for a in axes:
+                denom *= x.shape[a]
+            return s / denom, state
+        if pt == "pnorm":
+            p = float(self.pnorm)
+            return jnp.sum(jnp.abs(x) ** p, axis=axes) ** (1.0 / p), state
+        raise ValueError(f"Unknown pooling type {self.pooling_type}")
+
+    def feed_forward_mask(self, mask, input_type):
+        return None  # time dim is reduced away

@@ -1,0 +1,69 @@
+"""Denoising AutoEncoder layer.
+
+Parity: nn/conf/layers/AutoEncoder.java + nn/layers/feedforward/autoencoder/.
+Supervised forward = encoder; unsupervised `pretrain_loss` = reconstruction
+error after input corruption (masking noise with probability
+`corruption_level`), matching the reference's denoising-AE pretraining.
+(The reference's RBM layer is legacy/deprecated even there; AutoEncoder and
+VariationalAutoencoder cover the pretrain capability.)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf.inputs import InputType, InputTypeFeedForward
+from deeplearning4j_tpu.nn.layers.base import BaseLayer
+from deeplearning4j_tpu.nn.layers.core import DenseLayer
+from deeplearning4j_tpu.nn.losses import get_loss
+from deeplearning4j_tpu.nn.weights import init_weights
+
+
+@dataclass(kw_only=True)
+class AutoEncoder(BaseLayer):
+    corruption_level: float = 0.3
+    sparsity: float = 0.0
+    loss: str = "mse"
+    activation: Optional[str] = "sigmoid"
+
+    def set_n_in(self, input_type: InputType) -> None:
+        self.n_in = input_type.size if isinstance(
+            input_type, InputTypeFeedForward) else input_type.arrays_per_example()
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        kw, _ = jax.random.split(key)
+        W = init_weights(self.weight_init, kw, (self.n_in, self.n_out),
+                         fan_in=self.n_in, fan_out=self.n_out, dtype=dtype)
+        return {
+            "W": W,                                   # tied weights: decode with W.T
+            "b": jnp.zeros((self.n_out,), dtype),     # hidden bias
+            "vb": jnp.zeros((self.n_in,), dtype),     # visible (decode) bias
+        }
+
+    def encode(self, params, x):
+        return get_activation(self.activation)(x @ params["W"] + params["b"])
+
+    def decode(self, params, h):
+        return get_activation(self.activation)(h @ params["W"].T + params["vb"])
+
+    def apply(self, params, x, *, train=False, rng=None, state=None, mask=None):
+        x = self._maybe_dropout_input(x, train, rng)
+        return self.encode(params, x), state
+
+    def pretrain_loss(self, params, x, rng):
+        if self.corruption_level > 0.0 and rng is not None:
+            keep = jax.random.bernoulli(rng, 1.0 - self.corruption_level, x.shape)
+            corrupted = jnp.where(keep, x, 0.0)
+        else:
+            corrupted = x
+        recon_pre = self.encode(params, corrupted) @ params["W"].T + params["vb"]
+        per_ex = get_loss(self.loss)(x, recon_pre, self.activation)
+        return jnp.mean(per_ex)

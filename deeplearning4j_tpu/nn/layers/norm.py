@@ -1,0 +1,94 @@
+"""BatchNormalization.
+
+Parity: nn/conf/layers/BatchNormalization.java +
+nn/layers/normalization/BatchNormalization.java (cuDNN helper hook at
+:56-64). Running mean/var live in the layer's *state* pytree (not params), so
+`jax.grad` never differentiates them; the train-mode state update is returned
+functionally — this is the TPU-native replacement for the reference's mutable
+running-stat arrays.
+
+Works on [B, C] (feed-forward), [B, T, C] (recurrent), and [B, H, W, C]
+(NHWC conv) inputs — stats are taken over all axes but the channel axis.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.conf.inputs import (
+    InputType,
+    InputTypeConvolutional,
+    InputTypeFeedForward,
+    InputTypeRecurrent,
+)
+from deeplearning4j_tpu.nn.layers.base import Layer
+
+
+@dataclass(kw_only=True)
+class BatchNormalization(Layer):
+    n_out: Optional[int] = None   # channel count, inferred
+    decay: float = 0.9            # EMA decay for running stats (reference default)
+    eps: float = 1e-5
+    gamma: float = 1.0            # init values
+    beta: float = 0.0
+    lock_gamma_beta: bool = False # if True, gamma/beta fixed (not trained)
+
+    def has_params(self) -> bool:
+        return True
+
+    def _channels(self, input_type: InputType) -> int:
+        if isinstance(input_type, InputTypeConvolutional):
+            return input_type.channels
+        if isinstance(input_type, (InputTypeFeedForward, InputTypeRecurrent)):
+            return input_type.size
+        raise ValueError(f"BatchNormalization: unsupported input {input_type}")
+
+    def set_n_in(self, input_type: InputType) -> None:
+        self.n_out = self._channels(input_type)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        c = self.n_out or self._channels(input_type)
+        if self.lock_gamma_beta:
+            return {}
+        return {
+            "gamma": jnp.full((c,), self.gamma, dtype),
+            "beta": jnp.full((c,), self.beta, dtype),
+        }
+
+    def init_state(self, input_type, dtype=jnp.float32):
+        c = self.n_out or self._channels(input_type)
+        return {"mean": jnp.zeros((c,), dtype), "var": jnp.ones((c,), dtype)}
+
+    def apply(self, params, x, *, train=False, rng=None, state=None, mask=None):
+        axes = tuple(range(x.ndim - 1))
+        if train:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            new_state = None
+            if state is not None:
+                d = self.decay
+                new_state = {
+                    "mean": d * state["mean"] + (1.0 - d) * mean,
+                    "var": d * state["var"] + (1.0 - d) * var,
+                }
+        else:
+            if state is not None:
+                mean, var = state["mean"], state["var"]
+            else:
+                mean = jnp.mean(x, axis=axes)
+                var = jnp.var(x, axis=axes)
+            new_state = state
+
+        y = (x - mean) * lax.rsqrt(var + self.eps)
+        if not self.lock_gamma_beta and params:
+            y = y * params["gamma"] + params["beta"]
+        elif self.lock_gamma_beta:
+            y = y * self.gamma + self.beta
+        return y, new_state

@@ -1,0 +1,203 @@
+"""Recurrent layers: LSTM, GravesLSTM (peepholes), GravesBidirectionalLSTM.
+
+Parity: nn/conf/layers/{LSTM,GravesLSTM,GravesBidirectionalLSTM}.java and the
+hand-written per-timestep loops in nn/layers/recurrent/LSTMHelpers.java:182
+(forward) and :448 (backward).
+
+TPU-first design: the time loop is `lax.scan` (compiled once, not unrolled);
+the four gate matmuls are fused into ONE [*, 4H] matmul per step so the MXU
+sees a single large GEMM; the input projection x @ W for ALL timesteps is
+hoisted out of the scan as one [B*T, nIn] x [nIn, 4H] matmul. Backward comes
+from `jax.grad` differentiating the scan — no hand-written BPTT.
+
+Gate packing order along the 4H axis: [i (input), f (forget), o (output),
+g (cell candidate)].
+
+Masking: mask [B, T] freezes the carry where mask==0 (variable-length
+sequences in a static-shape batch).
+
+Streaming inference (`rnnTimeStep`, MultiLayerNetwork.java:2526): each layer
+exposes `step(params, x_t, carry)`; the container threads carries.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf.inputs import InputType, InputTypeRecurrent
+from deeplearning4j_tpu.nn.layers.base import BaseLayer
+from deeplearning4j_tpu.nn.weights import init_weights
+
+
+def _lstm_cell(gates_t, c_prev, gate_act, cell_act, peepholes=None):
+    """One LSTM cell update given the pre-activation fused gates [B, 4H]."""
+    H = c_prev.shape[-1]
+    i_g, f_g, o_g, g_g = jnp.split(gates_t, 4, axis=-1)
+    if peepholes is not None:
+        p_i, p_f, p_o = peepholes
+        i_g = i_g + c_prev * p_i
+        f_g = f_g + c_prev * p_f
+    i = gate_act(i_g)
+    f = gate_act(f_g)
+    g = cell_act(g_g)
+    c = f * c_prev + i * g
+    if peepholes is not None:
+        o_g = o_g + c * p_o
+    o = gate_act(o_g)
+    h = o * cell_act(c)
+    return h, c
+
+
+@dataclass(kw_only=True)
+class LSTM(BaseLayer):
+    """Standard LSTM over [B, T, nIn] -> [B, T, nOut]."""
+
+    activation: Optional[str] = "tanh"
+    gate_activation: str = "sigmoid"
+    forget_gate_bias_init: float = 1.0
+
+    _peepholes: bool = False  # GravesLSTM flips this
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if not isinstance(input_type, InputTypeRecurrent):
+            raise ValueError(f"LSTM needs recurrent input, got {input_type}")
+        self.n_in = input_type.size
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, getattr(input_type, "timeseries_length", None))
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        kW, kR, kP = jax.random.split(key, 3)
+        H = self.n_out
+        W = init_weights(self.weight_init, kW, (self.n_in, 4 * H),
+                         fan_in=self.n_in, fan_out=H, dtype=dtype)
+        RW = init_weights(self.weight_init, kR, (H, 4 * H),
+                          fan_in=H, fan_out=H, dtype=dtype)
+        b = jnp.zeros((4 * H,), dtype)
+        # forget-gate bias block = index 1 in [i, f, o, g] packing
+        b = b.at[H:2 * H].set(self.forget_gate_bias_init)
+        params = {"W": W, "RW": RW, "b": b}
+        if self._peepholes:
+            params["P"] = init_weights(
+                self.weight_init, kP, (3, H), fan_in=H, fan_out=H, dtype=dtype
+            )
+        return params
+
+    # ---- single-step cell (streaming inference + scan body) ----
+    def step(self, params, x_t, carry):
+        """x_t [B, nIn], carry (h [B,H], c [B,H]) -> (y_t [B,H], new carry)."""
+        h_prev, c_prev = carry
+        gate_act = get_activation(self.gate_activation)
+        cell_act = get_activation(self.activation)
+        gates = x_t @ params["W"] + h_prev @ params["RW"] + params["b"]
+        peep = tuple(params["P"]) if self._peepholes else None
+        h, c = _lstm_cell(gates, c_prev, gate_act, cell_act, peep)
+        return h, (h, c)
+
+    def initial_carry(self, batch_size, dtype=jnp.float32):
+        H = self.n_out
+        return (jnp.zeros((batch_size, H), dtype), jnp.zeros((batch_size, H), dtype))
+
+    def _scan(self, params, x, mask, carry0, reverse=False):
+        """Run the full sequence. x [B, T, nIn] -> outputs [B, T, H]."""
+        B, T, _ = x.shape
+        gate_act = get_activation(self.gate_activation)
+        cell_act = get_activation(self.activation)
+        peep = tuple(params["P"]) if self._peepholes else None
+
+        # Hoist the input projection for all timesteps: one big MXU matmul.
+        xw = x @ params["W"] + params["b"]          # [B, T, 4H]
+        xw_t = jnp.swapaxes(xw, 0, 1)               # [T, B, 4H] time-major scan
+        mask_t = None if mask is None else jnp.swapaxes(mask, 0, 1)  # [T, B]
+
+        def body(carry, inputs):
+            h_prev, c_prev = carry
+            if mask_t is None:
+                gates_t = inputs
+                m = None
+            else:
+                gates_t, m = inputs
+            gates = gates_t + h_prev @ params["RW"]
+            h, c = _lstm_cell(gates, c_prev, gate_act, cell_act, peep)
+            if m is not None:
+                keep = m[:, None]
+                h = jnp.where(keep > 0, h, h_prev)
+                c = jnp.where(keep > 0, c, c_prev)
+            return (h, c), h
+
+        xs = xw_t if mask_t is None else (xw_t, mask_t)
+        carry, hs = lax.scan(body, carry0, xs, reverse=reverse)
+        return jnp.swapaxes(hs, 0, 1), carry        # back to [B, T, H]
+
+    def apply(self, params, x, *, train=False, rng=None, state=None, mask=None):
+        x = self._maybe_dropout_input(x, train, rng)
+        carry0 = state if state is not None else self.initial_carry(x.shape[0], x.dtype)
+        out, carry = self._scan(params, x, mask, carry0)
+        return out, carry
+
+    def feed_forward_mask(self, mask, input_type):
+        return mask
+
+
+@dataclass(kw_only=True)
+class GravesLSTM(LSTM):
+    """LSTM with peephole connections (Graves 2013 formulation), the
+    reference's workhorse recurrent layer."""
+
+    _peepholes: bool = True
+
+
+@dataclass(kw_only=True)
+class GravesBidirectionalLSTM(BaseLayer):
+    """Bidirectional peephole LSTM; forward and backward passes concatenated
+    on the feature axis -> [B, T, 2*nOut]."""
+
+    activation: Optional[str] = "tanh"
+    gate_activation: str = "sigmoid"
+    forget_gate_bias_init: float = 1.0
+
+    def _directional(self) -> GravesLSTM:
+        return GravesLSTM(
+            n_in=self.n_in, n_out=self.n_out, activation=self.activation,
+            gate_activation=self.gate_activation,
+            forget_gate_bias_init=self.forget_gate_bias_init,
+            weight_init=self.weight_init, bias_init=self.bias_init,
+        )
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if not isinstance(input_type, InputTypeRecurrent):
+            raise ValueError(f"BiLSTM needs recurrent input, got {input_type}")
+        self.n_in = input_type.size
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(2 * self.n_out, getattr(input_type, "timeseries_length", None))
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        kf, kb = jax.random.split(key)
+        sub = self._directional()
+        return {
+            "fwd": sub.init_params(kf, input_type, dtype),
+            "bwd": sub.init_params(kb, input_type, dtype),
+        }
+
+    def apply(self, params, x, *, train=False, rng=None, state=None, mask=None):
+        x = self._maybe_dropout_input(x, train, rng)
+        sub = self._directional()
+        zero = sub.initial_carry(x.shape[0], x.dtype)
+        # The forward direction carries state across calls (TBPTT chunks /
+        # streaming); the backward direction is anti-causal, so it must
+        # restart from zero within each window — carrying it would leak
+        # future state backwards.
+        c0_fwd = state[0] if state is not None else zero
+        fwd, cf = sub._scan(params["fwd"], x, mask, c0_fwd)
+        bwd, cb = sub._scan(params["bwd"], x, mask, zero, reverse=True)
+        return jnp.concatenate([fwd, bwd], axis=-1), (cf, cb)
+
+    def feed_forward_mask(self, mask, input_type):
+        return mask

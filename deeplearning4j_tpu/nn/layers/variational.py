@@ -1,0 +1,141 @@
+"""Variational autoencoder layer.
+
+Parity: nn/conf/layers/variational/VariationalAutoencoder.java +
+nn/layers/variational/VariationalAutoencoder.java (1,142 LoC of hand-written
+forward/backward in the reference; here the ELBO is a pure function and
+`jax.grad` derives everything).
+
+Used two ways, like the reference:
+- unsupervised pretraining: `pretrain_loss` = negative ELBO
+  (reconstruction log-prob under the chosen distribution + KL(q(z|x) || N(0,I)))
+- supervised forward pass: `apply` runs the encoder mean path
+  (reference behavior: activate() returns the latent mean).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf.inputs import InputType, InputTypeFeedForward
+from deeplearning4j_tpu.nn.layers.base import BaseLayer
+from deeplearning4j_tpu.nn.weights import init_weights
+
+_HALF_LOG_2PI = 0.5 * jnp.log(2.0 * jnp.pi)
+
+
+def _mlp_init(key, sizes, weight_init, dtype):
+    params = []
+    keys = jax.random.split(key, len(sizes) - 1)
+    for k, (a, b) in zip(keys, zip(sizes[:-1], sizes[1:])):
+        W = init_weights(weight_init, k, (a, b), fan_in=a, fan_out=b, dtype=dtype)
+        params.append({"W": W, "b": jnp.zeros((b,), dtype)})
+    return params
+
+def _mlp_apply(params, x, act):
+    for p in params:
+        x = act(x @ p["W"] + p["b"])
+    return x
+
+
+@dataclass(kw_only=True)
+class VariationalAutoencoder(BaseLayer):
+    encoder_layer_sizes: Sequence[int] = (100,)
+    decoder_layer_sizes: Sequence[int] = (100,)
+    latent_size: int = 32              # == n_out for the supervised path
+    reconstruction_distribution: str = "gaussian"  # gaussian | bernoulli
+    pzx_activation: str = "identity"   # activation on latent mean/logvar heads
+    num_samples: int = 1
+    activation: Optional[str] = "tanh"
+
+    def __post_init__(self):
+        if self.n_out is None:
+            self.n_out = self.latent_size
+
+    def set_n_in(self, input_type: InputType) -> None:
+        self.n_in = input_type.arrays_per_example() if not isinstance(
+            input_type, InputTypeFeedForward) else input_type.size
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(self.latent_size)
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        k_enc, k_mu, k_lv, k_dec, k_out = jax.random.split(key, 5)
+        enc_sizes = [self.n_in, *self.encoder_layer_sizes]
+        dec_sizes = [self.latent_size, *self.decoder_layer_sizes]
+        eh = enc_sizes[-1]
+        dh = dec_sizes[-1]
+        # gaussian reconstruction emits mean+logvar; bernoulli emits logits
+        out_mult = 2 if self.reconstruction_distribution == "gaussian" else 1
+        wi = self.weight_init
+        return {
+            "encoder": _mlp_init(k_enc, enc_sizes, wi, dtype),
+            "mu": {
+                "W": init_weights(wi, k_mu, (eh, self.latent_size),
+                                  fan_in=eh, fan_out=self.latent_size, dtype=dtype),
+                "b": jnp.zeros((self.latent_size,), dtype),
+            },
+            "logvar": {
+                "W": init_weights(wi, k_lv, (eh, self.latent_size),
+                                  fan_in=eh, fan_out=self.latent_size, dtype=dtype),
+                "b": jnp.zeros((self.latent_size,), dtype),
+            },
+            "decoder": _mlp_init(k_dec, dec_sizes, wi, dtype),
+            "out": {
+                "W": init_weights(wi, k_out, (dh, out_mult * self.n_in),
+                                  fan_in=dh, fan_out=out_mult * self.n_in, dtype=dtype),
+                "b": jnp.zeros((out_mult * self.n_in,), dtype),
+            },
+        }
+
+    def encode(self, params, x):
+        act = get_activation(self.activation)
+        h = _mlp_apply(params["encoder"], x, act)
+        head_act = get_activation(self.pzx_activation)
+        mu = head_act(h @ params["mu"]["W"] + params["mu"]["b"])
+        logvar = head_act(h @ params["logvar"]["W"] + params["logvar"]["b"])
+        return mu, logvar
+
+    def decode(self, params, z):
+        act = get_activation(self.activation)
+        h = _mlp_apply(params["decoder"], z, act)
+        return h @ params["out"]["W"] + params["out"]["b"]
+
+    def apply(self, params, x, *, train=False, rng=None, state=None, mask=None):
+        x = self._maybe_dropout_input(x, train, rng)
+        mu, _ = self.encode(params, x)
+        return mu, state
+
+    def reconstruct(self, params, x, rng=None):
+        """Encode → (sample or mean) → decode → reconstruction mean."""
+        mu, logvar = self.encode(params, x)
+        z = mu if rng is None else mu + jnp.exp(0.5 * logvar) * jax.random.normal(
+            rng, mu.shape, mu.dtype)
+        out = self.decode(params, z)
+        if self.reconstruction_distribution == "gaussian":
+            return jnp.split(out, 2, axis=-1)[0]
+        return jax.nn.sigmoid(out)
+
+    def pretrain_loss(self, params, x, rng):
+        """Negative ELBO, mean over the batch."""
+        mu, logvar = self.encode(params, x)
+        total = 0.0
+        keys = jax.random.split(rng, self.num_samples)
+        for k in keys:
+            eps = jax.random.normal(k, mu.shape, mu.dtype)
+            z = mu + jnp.exp(0.5 * logvar) * eps
+            out = self.decode(params, z)
+            if self.reconstruction_distribution == "gaussian":
+                r_mu, r_logvar = jnp.split(out, 2, axis=-1)
+                logp = -0.5 * ((x - r_mu) ** 2 * jnp.exp(-r_logvar)
+                               + r_logvar) - _HALF_LOG_2PI
+            else:  # bernoulli with logits
+                logp = x * jax.nn.log_sigmoid(out) + (1 - x) * jax.nn.log_sigmoid(-out)
+            total = total + jnp.sum(logp, axis=-1)
+        recon = total / self.num_samples
+        kl = 0.5 * jnp.sum(jnp.exp(logvar) + mu * mu - 1.0 - logvar, axis=-1)
+        return jnp.mean(-recon + kl)

@@ -1,0 +1,180 @@
+"""Loss functions.
+
+Parity with the reference's `LossFunctions.LossFunction` set consumed by
+output layers (ref: deeplearning4j-nn/.../nn/conf/layers/OutputLayer config;
+score computed at MultiLayerNetwork.java:2138). Following the reference's
+`ILossFunction` contract, a loss receives the *pre-activation* output and the
+activation function, which lets us use numerically-stable fused forms
+(log-softmax cross-entropy, sigmoid BCE-with-logits) — on TPU these fuse into
+the preceding matmul's epilogue under XLA.
+
+Every loss returns **per-example** loss of shape [batch] (time/feature axes
+reduced), so containers can apply minibatch averaging and masking uniformly.
+Masks broadcast against the label shape: per-timestep masks are [B, T] for
+[B, T, C] labels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import get_activation
+
+_EPS = 1e-7
+
+
+def _reduce_per_example(loss_elems, mask):
+    """Sum all non-batch axes; apply mask first if given."""
+    if mask is not None:
+        m = mask
+        while m.ndim < loss_elems.ndim:
+            m = m[..., None]
+        loss_elems = loss_elems * m
+    axes = tuple(range(1, loss_elems.ndim))
+    return jnp.sum(loss_elems, axis=axes) if axes else loss_elems
+
+
+def _activate(pre_output, activation):
+    return get_activation(activation)(pre_output)
+
+
+def mse(labels, pre_output, activation="identity", mask=None):
+    out = _activate(pre_output, activation)
+    # Reference convention: mean over the feature axis, sum over time.
+    n_features = labels.shape[-1]
+    return _reduce_per_example((out - labels) ** 2, mask) / n_features
+
+
+def l2(labels, pre_output, activation="identity", mask=None):
+    out = _activate(pre_output, activation)
+    return _reduce_per_example((out - labels) ** 2, mask)
+
+
+def mae(labels, pre_output, activation="identity", mask=None):
+    out = _activate(pre_output, activation)
+    n_features = labels.shape[-1]
+    return _reduce_per_example(jnp.abs(out - labels), mask) / n_features
+
+
+def l1(labels, pre_output, activation="identity", mask=None):
+    out = _activate(pre_output, activation)
+    return _reduce_per_example(jnp.abs(out - labels), mask)
+
+
+def mape(labels, pre_output, activation="identity", mask=None):
+    out = _activate(pre_output, activation)
+    n_features = labels.shape[-1]
+    pct = 100.0 * jnp.abs((out - labels) / (labels + _EPS))
+    return _reduce_per_example(pct, mask) / n_features
+
+
+def msle(labels, pre_output, activation="identity", mask=None):
+    out = _activate(pre_output, activation)
+    n_features = labels.shape[-1]
+    d = jnp.log1p(out) - jnp.log1p(labels)
+    return _reduce_per_example(d * d, mask) / n_features
+
+
+def mcxent(labels, pre_output, activation="softmax", mask=None):
+    """Multi-class cross-entropy. Stable fused path when activation=softmax."""
+    act = str(activation).lower() if not callable(activation) else activation
+    if act == "softmax":
+        logp = jax.nn.log_softmax(pre_output, axis=-1)
+    else:
+        out = _activate(pre_output, activation)
+        logp = jnp.log(jnp.clip(out, _EPS, 1.0))
+    return _reduce_per_example(-labels * logp, mask)
+
+
+def negativeloglikelihood(labels, pre_output, activation="softmax", mask=None):
+    # Reference treats NLL as MCXENT (same math for one-hot labels).
+    return mcxent(labels, pre_output, activation, mask)
+
+
+def xent(labels, pre_output, activation="sigmoid", mask=None):
+    """Binary cross-entropy. Stable logits path when activation=sigmoid."""
+    act = str(activation).lower() if not callable(activation) else activation
+    if act == "sigmoid":
+        # BCE with logits: max(x,0) - x*z + log(1+exp(-|x|))
+        x, z = pre_output, labels
+        elems = jnp.maximum(x, 0) - x * z + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    else:
+        out = jnp.clip(_activate(pre_output, activation), _EPS, 1.0 - _EPS)
+        elems = -(labels * jnp.log(out) + (1.0 - labels) * jnp.log(1.0 - out))
+    return _reduce_per_example(elems, mask)
+
+
+def hinge(labels, pre_output, activation="identity", mask=None):
+    """Hinge loss; labels in {-1, +1} (or {0,1}, auto-mapped)."""
+    out = _activate(pre_output, activation)
+    y = jnp.where(labels <= 0, -1.0, 1.0)
+    return _reduce_per_example(jnp.maximum(0.0, 1.0 - y * out), mask)
+
+
+def squared_hinge(labels, pre_output, activation="identity", mask=None):
+    out = _activate(pre_output, activation)
+    y = jnp.where(labels <= 0, -1.0, 1.0)
+    h = jnp.maximum(0.0, 1.0 - y * out)
+    return _reduce_per_example(h * h, mask)
+
+
+def poisson(labels, pre_output, activation="identity", mask=None):
+    out = _activate(pre_output, activation)
+    elems = out - labels * jnp.log(jnp.clip(out, _EPS, None))
+    return _reduce_per_example(elems, mask)
+
+
+def kl_divergence(labels, pre_output, activation="softmax", mask=None):
+    out = jnp.clip(_activate(pre_output, activation), _EPS, None)
+    p = jnp.clip(labels, _EPS, None)
+    return _reduce_per_example(labels * (jnp.log(p) - jnp.log(out)), mask)
+
+
+def cosine_proximity(labels, pre_output, activation="identity", mask=None):
+    out = _activate(pre_output, activation)
+    if mask is not None:
+        m = mask
+        while m.ndim < out.ndim:
+            m = m[..., None]
+        out = out * m
+        labels = labels * m
+    dot = jnp.sum(labels * out, axis=-1)
+    norms = (
+        jnp.linalg.norm(labels, axis=-1) * jnp.linalg.norm(out, axis=-1) + _EPS
+    )
+    cos = dot / norms
+    axes = tuple(range(1, cos.ndim))
+    return -(jnp.sum(cos, axis=axes) if axes else cos)
+
+
+LOSSES = {
+    "mse": mse,
+    "l2": l2,
+    "mae": mae,
+    "mean_absolute_error": mae,
+    "l1": l1,
+    "mape": mape,
+    "mean_absolute_percentage_error": mape,
+    "msle": msle,
+    "mean_squared_logarithmic_error": msle,
+    "mcxent": mcxent,
+    "negativeloglikelihood": negativeloglikelihood,
+    "xent": xent,
+    "hinge": hinge,
+    "squared_hinge": squared_hinge,
+    "poisson": poisson,
+    "kl_divergence": kl_divergence,
+    "reconstruction_crossentropy": xent,
+    "cosine_proximity": cosine_proximity,
+}
+
+
+def get_loss(name):
+    """Resolve a loss by name (case-insensitive) or pass callables through."""
+    if callable(name):
+        return name
+    key = str(name).lower()
+    if key not in LOSSES:
+        raise ValueError(f"Unknown loss '{name}'. Known: {sorted(LOSSES)}")
+    return LOSSES[key]

@@ -1,0 +1,118 @@
+"""Weight initialization schemes.
+
+Parity with the reference's `WeightInit` enum + `WeightInitUtil`
+(ref: deeplearning4j-nn/.../nn/weights/WeightInit.java, WeightInitUtil.java;
+XAVIER is the reference default, NeuralNetConfiguration.java:522).
+
+Each scheme is `init(key, shape, fan_in, fan_out, dtype, **kwargs) -> array`.
+Fan-in/fan-out are passed explicitly because conv fans differ from the
+trailing dims of the kernel shape.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _uniform(key, shape, low, high, dtype):
+    return jax.random.uniform(key, shape, minval=low, maxval=high, dtype=dtype)
+
+
+def zero(key, shape, fan_in, fan_out, dtype=jnp.float32, **kw):
+    return jnp.zeros(shape, dtype)
+
+
+def ones(key, shape, fan_in, fan_out, dtype=jnp.float32, **kw):
+    return jnp.ones(shape, dtype)
+
+
+def constant(key, shape, fan_in, fan_out, dtype=jnp.float32, value=0.0, **kw):
+    return jnp.full(shape, value, dtype)
+
+
+def uniform(key, shape, fan_in, fan_out, dtype=jnp.float32, **kw):
+    a = 1.0 / jnp.sqrt(fan_in)
+    return _uniform(key, shape, -a, a, dtype)
+
+
+def xavier(key, shape, fan_in, fan_out, dtype=jnp.float32, **kw):
+    """Glorot normal: N(0, 2/(fan_in+fan_out))."""
+    std = jnp.sqrt(2.0 / (fan_in + fan_out))
+    return jax.random.normal(key, shape, dtype) * std
+
+
+def xavier_uniform(key, shape, fan_in, fan_out, dtype=jnp.float32, **kw):
+    a = jnp.sqrt(6.0 / (fan_in + fan_out))
+    return _uniform(key, shape, -a, a, dtype)
+
+
+def xavier_fan_in(key, shape, fan_in, fan_out, dtype=jnp.float32, **kw):
+    return jax.random.normal(key, shape, dtype) / jnp.sqrt(fan_in)
+
+
+def xavier_legacy(key, shape, fan_in, fan_out, dtype=jnp.float32, **kw):
+    std = jnp.sqrt(1.0 / (fan_in + fan_out))
+    return jax.random.normal(key, shape, dtype) * std
+
+
+def relu_init(key, shape, fan_in, fan_out, dtype=jnp.float32, **kw):
+    """He normal: N(0, 2/fan_in)."""
+    return jax.random.normal(key, shape, dtype) * jnp.sqrt(2.0 / fan_in)
+
+
+def relu_uniform(key, shape, fan_in, fan_out, dtype=jnp.float32, **kw):
+    a = jnp.sqrt(6.0 / fan_in)
+    return _uniform(key, shape, -a, a, dtype)
+
+
+def sigmoid_uniform(key, shape, fan_in, fan_out, dtype=jnp.float32, **kw):
+    a = 4.0 * jnp.sqrt(6.0 / (fan_in + fan_out))
+    return _uniform(key, shape, -a, a, dtype)
+
+
+def normal(key, shape, fan_in, fan_out, dtype=jnp.float32, mean=0.0, std=None, **kw):
+    """Distribution-style init; default std mirrors fan-in scaling."""
+    if std is None:
+        std = 1.0 / jnp.sqrt(fan_in)
+    return mean + jax.random.normal(key, shape, dtype) * std
+
+
+def lecun_normal(key, shape, fan_in, fan_out, dtype=jnp.float32, **kw):
+    return jax.random.normal(key, shape, dtype) * jnp.sqrt(1.0 / fan_in)
+
+
+def lecun_uniform(key, shape, fan_in, fan_out, dtype=jnp.float32, **kw):
+    a = jnp.sqrt(3.0 / fan_in)
+    return _uniform(key, shape, -a, a, dtype)
+
+
+WEIGHT_INITS = {
+    "zero": zero,
+    "ones": ones,
+    "constant": constant,
+    "uniform": uniform,
+    "xavier": xavier,
+    "xavier_uniform": xavier_uniform,
+    "xavier_fan_in": xavier_fan_in,
+    "xavier_legacy": xavier_legacy,
+    "relu": relu_init,
+    "relu_uniform": relu_uniform,
+    "sigmoid_uniform": sigmoid_uniform,
+    "normal": normal,
+    "distribution": normal,
+    "lecun_normal": lecun_normal,
+    "lecun_uniform": lecun_uniform,
+}
+
+
+def init_weights(name, key, shape, fan_in, fan_out, dtype=jnp.float32, **kwargs):
+    """Initialize a weight array with the named scheme (default: xavier)."""
+    if callable(name):
+        return name(key, shape, fan_in, fan_out, dtype, **kwargs)
+    key_name = str(name).lower()
+    if key_name not in WEIGHT_INITS:
+        raise ValueError(
+            f"Unknown weight init '{name}'. Known: {sorted(WEIGHT_INITS)}"
+        )
+    return WEIGHT_INITS[key_name](key, shape, fan_in, fan_out, dtype, **kwargs)

@@ -1,0 +1,185 @@
+"""End-to-end smoke tests: config -> init -> fit -> output -> score."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf import InputType
+from deeplearning4j_tpu.nn.layers import (
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    GravesLSTM,
+    OutputLayer,
+    RnnOutputLayer,
+    SubsamplingLayer,
+)
+
+
+def _toy_classification(rng, n=64, d=10, c=3):
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d, c))
+    y_idx = (x @ w).argmax(axis=1)
+    y = np.eye(c, dtype=np.float32)[y_idx]
+    return x, y
+
+
+def test_mlp_fit_reduces_loss(rng):
+    x, y = _toy_classification(rng)
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .seed(42)
+        .updater("adam")
+        .learning_rate(0.01)
+        .activation("relu")
+        .weight_init("xavier")
+        .list()
+        .layer(DenseLayer(n_out=32))
+        .layer(DenseLayer(n_out=16))
+        .layer(OutputLayer(n_out=3, loss="mcxent"))
+        .set_input_type(InputType.feed_forward(10))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    loss0 = net.score((x, y))
+    net.fit([(x, y)], epochs=30)
+    loss1 = net.score((x, y))
+    assert loss1 < loss0 * 0.7
+    out = net.output(x)
+    assert out.shape == (64, 3)
+    assert np.allclose(np.asarray(out).sum(axis=1), 1.0, atol=1e-4)
+
+
+def test_global_defaults_inherited():
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .activation("tanh")
+        .l2(1e-4)
+        .list()
+        .layer(DenseLayer(n_out=8))
+        .layer(OutputLayer(n_out=2))
+        .set_input_type(InputType.feed_forward(4))
+        .build()
+    )
+    assert conf.layers[0].activation == "tanh"
+    assert conf.layers[0].l2 == 1e-4
+    # OutputLayer keeps its class default (softmax), not the global
+    assert conf.layers[1].activation == "softmax"
+    # nIn inferred
+    assert conf.layers[0].n_in == 4
+    assert conf.layers[1].n_in == 8
+
+
+def test_cnn_shape_inference_and_fit(rng):
+    x = rng.normal(size=(8, 12, 12, 1)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, size=8)]
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .updater("adam").learning_rate(0.01)
+        .list()
+        .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3), activation="relu"))
+        .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        .layer(BatchNormalization())
+        .layer(DenseLayer(n_out=16, activation="relu"))
+        .layer(OutputLayer(n_out=2))
+        .set_input_type(InputType.convolutional(12, 12, 1))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    net.fit([(x, y)], epochs=2)
+    assert net.output(x).shape == (8, 2)
+    # conv shape math: 12 -> conv3 -> 10 -> pool2 -> 5
+    types = net.layer_input_types
+    assert types[1].height == 10 and types[1].width == 10
+    assert types[3].size == 5 * 5 * 4
+
+
+def test_lstm_sequence_classification(rng):
+    B, T, D, C = 8, 5, 6, 2
+    x = rng.normal(size=(B, T, D)).astype(np.float32)
+    y = np.zeros((B, T, C), dtype=np.float32)
+    y[:, :, 0] = 1.0
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .updater("adam").learning_rate(0.02)
+        .list()
+        .layer(GravesLSTM(n_out=8))
+        .layer(RnnOutputLayer(n_out=C, loss="mcxent"))
+        .set_input_type(InputType.recurrent(D, T))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    s0 = net.score((x, y))
+    net.fit([(x, y)], epochs=20)
+    assert net.score((x, y)) < s0
+    out = net.output(x)
+    assert out.shape == (B, T, C)
+
+
+def test_rnn_time_step_matches_full_forward(rng):
+    B, T, D = 4, 6, 5
+    x = rng.normal(size=(B, T, D)).astype(np.float32)
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .list()
+        .layer(GravesLSTM(n_out=7))
+        .layer(RnnOutputLayer(n_out=3))
+        .set_input_type(InputType.recurrent(D, T))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    full = np.asarray(net.output(x))
+    net.clear_rnn_state()
+    stepwise = []
+    for t in range(T):
+        stepwise.append(np.asarray(net.rnn_time_step(x[:, t, :])))
+    stepwise = np.stack(stepwise, axis=1)
+    np.testing.assert_allclose(full, stepwise, rtol=1e-4, atol=1e-5)
+
+
+def test_json_round_trip():
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .updater("adam").learning_rate(0.005).seed(7)
+        .list()
+        .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3)))
+        .layer(SubsamplingLayer())
+        .layer(DenseLayer(n_out=16, activation="relu"))
+        .layer(OutputLayer(n_out=2))
+        .set_input_type(InputType.convolutional(8, 8, 1))
+        .build()
+    )
+    from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+
+    js = conf.to_json()
+    conf2 = MultiLayerConfiguration.from_json(js)
+    assert conf2.to_json() == js
+    assert conf2.updater == "adam"
+    assert conf2.layers[0].kernel_size == (3, 3)
+    # round-tripped config must be trainable
+    net = MultiLayerNetwork(conf2).init()
+    assert net.num_params() > 0
+
+
+def test_tbptt_training(rng):
+    B, T, D, C = 4, 12, 5, 2
+    x = rng.normal(size=(B, T, D)).astype(np.float32)
+    y = np.zeros((B, T, C), dtype=np.float32)
+    y[:, :, 1] = 1.0
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .updater("sgd").learning_rate(0.05)
+        .list()
+        .layer(GravesLSTM(n_out=6))
+        .layer(RnnOutputLayer(n_out=C))
+        .set_input_type(InputType.recurrent(D, T))
+        .backprop_type("truncated_bptt")
+        .t_bptt_forward_length(4)
+        .t_bptt_backward_length(4)
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    s0 = net.score((x, y))
+    net.fit([(x, y)], epochs=10)
+    assert net.score((x, y)) < s0
