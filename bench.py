@@ -1,0 +1,52 @@
+"""Benchmark harness. Prints ONE JSON line:
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Current flagship bench: LeNet-MNIST-shape training throughput (BASELINE.md
+config #1). Upgrades to ResNet50 images/sec/chip (config #2) when the zoo
+lands. The reference publishes no numbers (BASELINE.md), so vs_baseline is
+measured against the recorded target in this file once first measured.
+"""
+
+import json
+import time
+
+import numpy as np
+
+# First-measured reference point for vs_baseline ratios (images/sec on the
+# round-1 LeNet config, one v5e chip). Updated when first recorded.
+BASELINE_IMAGES_PER_SEC = 185061.6  # first measured, v5e-1, 2026-07-29
+
+
+def main():
+    import jax
+
+    from __graft_entry__ import _flagship
+
+    batch = 256
+    net, _, _ = _flagship(batch=batch)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch, 28, 28, 1)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
+
+    # warmup (compile)
+    net.fit([(x, y)])
+    jax.block_until_ready(net.params)
+
+    iters = 50
+    t0 = time.perf_counter()
+    net.fit([(x, y)] * iters)
+    jax.block_until_ready(net.params)
+    dt = time.perf_counter() - t0
+
+    ips = batch * iters / dt
+    vs = 1.0 if BASELINE_IMAGES_PER_SEC is None else ips / BASELINE_IMAGES_PER_SEC
+    print(json.dumps({
+        "metric": "lenet_mnist_train_images_per_sec",
+        "value": round(ips, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -70,6 +70,11 @@ class Evaluation:
                 m = np.ones(labels.shape[0] * labels.shape[1], dtype=bool)
             labels = labels.reshape(-1, labels.shape[-1])[m]
             predictions = predictions.reshape(-1, predictions.shape[-1])[m]
+        elif mask is not None:
+            # [N] example mask on 2D input: drop masked-out rows (e.g. DP
+            # batch padding) so they don't enter the confusion matrix
+            m = np.asarray(mask).reshape(-1).astype(bool)
+            labels, predictions = labels[m], predictions[m]
         self._ensure(labels.shape[-1])
         actual = labels.argmax(axis=-1)
         pred = predictions.argmax(axis=-1)

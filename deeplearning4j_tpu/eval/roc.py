@@ -55,9 +55,9 @@ class ROC:
         if labels.ndim == 3:
             labels = labels.reshape(-1, labels.shape[-1])
             predictions = predictions.reshape(-1, predictions.shape[-1])
-            if mask is not None:
-                m = np.asarray(mask).reshape(-1).astype(bool)
-                labels, predictions = labels[m], predictions[m]
+        if mask is not None:
+            m = np.asarray(mask).reshape(-1).astype(bool)
+            labels, predictions = labels[m], predictions[m]
         if labels.shape[-1] == 2:
             pos = labels[:, 1] >= 0.5
             score = predictions[:, 1]

@@ -41,10 +41,11 @@ def _pair(v) -> Tuple[int, int]:
     return (int(v), int(v))
 
 
-def _out_dim(size, k, s, pad, mode):
+def _out_dim(size, k, s, pad, mode, dilation=1):
     if mode == "same":
         return -(-size // s)  # ceil
-    return (size + 2 * pad - k) // s + 1
+    k_eff = k + (k - 1) * (dilation - 1)
+    return (size + 2 * pad - k_eff) // s + 1
 
 
 def _explicit_padding(mode, pad):
@@ -76,9 +77,10 @@ class ConvolutionLayer(BaseLayer):
         kh, kw = _pair(self.kernel_size)
         sh, sw = _pair(self.stride)
         ph, pw = _pair(self.padding)
+        dh, dw = _pair(self.dilation)
         mode = self.convolution_mode
-        h = _out_dim(input_type.height, kh, sh, ph, mode)
-        w = _out_dim(input_type.width, kw, sw, pw, mode)
+        h = _out_dim(input_type.height, kh, sh, ph, mode, dh)
+        w = _out_dim(input_type.width, kw, sw, pw, mode, dw)
         if h <= 0 or w <= 0:
             raise ValueError(
                 f"Invalid conv output {h}x{w} from {input_type} with "
@@ -220,6 +222,7 @@ class Subsampling1DLayer(Layer):
     kernel_size: int = 2
     stride: int = 2
     padding: int = 0
+    pnorm: int = 2
 
     def output_type(self, input_type: InputType) -> InputType:
         t = input_type.timeseries_length
@@ -231,12 +234,22 @@ class Subsampling1DLayer(Layer):
         window = (1, self.kernel_size, 1)
         strides = (1, self.stride, 1)
         pad = ((0, 0), (self.padding, self.padding), (0, 0))
-        if self.pooling_type.lower() == "max":
+        pt = self.pooling_type.lower()
+        if pt == "max":
             y = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pad)
-        else:
+        elif pt == "avg":
             y = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
-            if self.pooling_type.lower() == "avg":
-                y = y / self.kernel_size
+            y = y / self.kernel_size
+        elif pt == "sum":
+            y = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
+        elif pt == "pnorm":
+            p = float(self.pnorm)
+            y = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add,
+                                  window, strides, pad) ** (1.0 / p)
+        else:
+            raise ValueError(
+                f"Unknown pooling_type '{self.pooling_type}' "
+                "(known: max, avg, sum, pnorm)")
         return y, state
 
 

@@ -173,11 +173,16 @@ class MultiLayerNetwork:
         pre = out_layer.pre_output(params[-1], cur)
         per_ex = out_layer.compute_per_example_loss(y, pre, mask=lmask)
         if lmask is not None:
-            # masked mean: per_ex is already mask-zeroed inside the loss;
-            # divide by the active count ([B] example masks and [B, T]
-            # timestep masks both normalize per active element)
-            denom = jnp.maximum(jnp.sum(lmask), 1.0)
-            loss = jnp.sum(per_ex) / denom
+            # per_ex is already mask-zeroed inside the loss. Normalize by
+            # the number of *active examples* (rows with any unmasked
+            # element), matching the reference's score/minibatchSize
+            # convention (MLN.java:2138): an all-ones mask gives exactly
+            # the unmasked loss, and fully-masked padding rows (DP batch
+            # padding) don't dilute the mean.
+            active = lmask if lmask.ndim == 1 else jnp.any(lmask > 0, axis=1)
+            total = jnp.sum(per_ex)
+            loss = (total / jnp.maximum(jnp.sum(active), 1.0)
+                    if conf.minibatch else total)
         elif conf.minibatch:
             loss = jnp.mean(per_ex)
         else:
@@ -280,6 +285,10 @@ class MultiLayerNetwork:
             self.init()
         if labels is not None:
             batches: Sequence = [(data, labels)]
+        elif isinstance(data, tuple):
+            # a tuple is ONE batch (x, y[, fmask, lmask]) — same shape
+            # score() accepts; lists/iterators are sequences of batches
+            batches = [data]
         elif hasattr(data, "__iter__") and not hasattr(data, "features"):
             batches = data
             if epochs > 1 and iter(batches) is batches and not hasattr(batches, "reset"):
@@ -396,6 +405,14 @@ class MultiLayerNetwork:
         x: [B, nIn] single step or [B, T, nIn] chunk; keeps per-layer carries
         in self.rnn_states.
         """
+        for layer in self.conf.layers:
+            if isinstance(layer, GravesBidirectionalLSTM):
+                # the backward scan needs the full sequence; stepwise
+                # decoding would silently be wrong (the reference throws
+                # for rnnTimeStep on bidirectional layers too)
+                raise ValueError(
+                    "rnn_time_step is not supported for bidirectional "
+                    "RNN layers; use output() on the full sequence")
         x = jnp.asarray(x, self.dtype)
         single = x.ndim == 2
         if single:

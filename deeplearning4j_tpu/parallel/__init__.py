@@ -1,0 +1,22 @@
+"""Parallelism: TPU-native replacement for the reference's scaleout stack.
+
+The reference implements data parallelism four ways (in-process parameter
+averaging / shared gradients via ParallelWrapper, Spark BSP parameter
+averaging, Spark async gradient sharing over Aeron — ref:
+deeplearning4j-scaleout/.../ParallelWrapper.java:54,
+spark/impl/paramavg/ParameterAveragingTrainingMaster.java:80,
+parameterserver/training/SharedTrainingMaster.java:72). On TPU all four
+collapse into one mechanism: a `jax.sharding.Mesh` over the chips and a
+single jit-compiled train step whose gradient reduction is an XLA
+all-reduce riding the ICI fabric. Tensor/sequence parallelism (absent from
+the reference) are first-class here via the same mesh axes.
+"""
+
+from deeplearning4j_tpu.parallel.mesh import make_mesh, MeshSpec  # noqa: F401
+from deeplearning4j_tpu.parallel.sharding import (  # noqa: F401
+    batch_sharding,
+    param_shardings,
+    replicated,
+)
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper  # noqa: F401
+from deeplearning4j_tpu.parallel.inference import ParallelInference  # noqa: F401

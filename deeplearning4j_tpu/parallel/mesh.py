@@ -1,0 +1,76 @@
+"""Device-mesh construction.
+
+Axis convention (the framework's standard mesh axes; every parallel
+component names these rather than inventing its own):
+
+- ``dp``: data parallel — batch dim sharded, params replicated.
+- ``tp``: tensor parallel — weight matrices sharded, activations gathered
+  by XLA-inserted collectives.
+- ``pp``: pipeline parallel — layer groups per stage.
+- ``sp``: sequence/context parallel — time dim sharded (ring attention).
+- ``ep``: expert parallel — experts sharded (MoE layers).
+
+The reference's ParallelWrapper pins one model replica per device thread
+(ParallelWrapper.java:122,189); here a mesh axis of size N is the
+declarative equivalent, and XLA lays collectives onto ICI links.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+
+AXES = ("dp", "pp", "sp", "ep", "tp")
+
+
+@dataclass
+class MeshSpec:
+    """Declarative mesh shape. Unspecified axes default to 1.
+
+    tp is the minor (fastest-varying) axis so tensor-parallel collectives
+    ride the shortest ICI hops; dp is major.
+    """
+
+    dp: int = 1
+    pp: int = 1
+    sp: int = 1
+    ep: int = 1
+    tp: int = 1
+
+    def total(self) -> int:
+        return self.dp * self.pp * self.sp * self.ep * self.tp
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {a: getattr(self, a) for a in AXES}
+
+
+def make_mesh(spec: Optional[MeshSpec] = None,
+              devices: Optional[Sequence] = None,
+              **axis_sizes) -> jax.sharding.Mesh:
+    """Build a Mesh over the given (default: all) devices.
+
+    make_mesh(dp=4, tp=2) → Mesh with axes ("dp","pp","sp","ep","tp") of
+    sizes (4,1,1,1,2). An axis set to -1 absorbs all remaining devices.
+    """
+    if spec is None:
+        spec = MeshSpec(**{a: axis_sizes.get(a, 1) for a in AXES})
+    devices = list(jax.devices()) if devices is None else list(devices)
+    sizes = spec.axis_sizes()
+    wild = [a for a, s in sizes.items() if s == -1]
+    if len(wild) > 1:
+        raise ValueError("at most one mesh axis may be -1")
+    if wild:
+        fixed = int(np.prod([s for s in sizes.values() if s != -1]))
+        if len(devices) % fixed:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by fixed axes {fixed}")
+        sizes[wild[0]] = len(devices) // fixed
+    total = int(np.prod(list(sizes.values())))
+    if total > len(devices):
+        raise ValueError(
+            f"mesh needs {total} devices, only {len(devices)} available")
+    arr = np.array(devices[:total]).reshape([sizes[a] for a in AXES])
+    return jax.sharding.Mesh(arr, AXES)

@@ -1,0 +1,83 @@
+"""Sharding rules: map a network's param/batch pytrees onto mesh axes.
+
+The reference has no notion of parameter sharding (params are replicated
+per device thread, ParallelWrapper.java:122); tensor parallelism here is a
+new first-class capability. Rules are deliberately simple and GSPMD-
+friendly: annotate the big matmul weights, let XLA propagate the rest.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, ndim: int = None, axis: str = "dp",
+                   seq_axis: Optional[str] = None) -> NamedSharding:
+    """Shard the leading (batch) dim over `axis`; optionally the second
+    (time) dim over `seq_axis` for sequence parallelism."""
+    spec = [axis]
+    if seq_axis is not None:
+        spec.append(seq_axis)
+    return NamedSharding(mesh, P(*spec))
+
+
+def _tp_spec(path_str: str, leaf, mesh: Mesh, tp_axis: str) -> P:
+    """Tensor-parallel partition rule for one param leaf.
+
+    Megatron-style: shard the output-features dim of weight matrices over
+    tp when divisible; biases/gains follow their matrix's output dim;
+    scalars and small vectors replicate. Conv kernels [kh,kw,cin,cout]
+    shard cout. Embedding tables [vocab, dim] shard vocab (row-sharded so
+    lookups psum).
+    """
+    tp = mesh.shape[tp_axis]
+    if tp == 1 or leaf.ndim == 0:
+        return P()
+    shape = leaf.shape
+    if leaf.ndim >= 2:
+        # weight-like: shard the trailing (out-features) dim
+        if shape[-1] % tp == 0:
+            return P(*([None] * (leaf.ndim - 1) + [tp_axis]))
+        if shape[0] % tp == 0:
+            return P(*([tp_axis] + [None] * (leaf.ndim - 1)))
+        return P()
+    # 1-D: bias/gamma/beta — shard if divisible (matches out-dim sharding)
+    if shape[0] % tp == 0 and shape[0] >= tp * 8:
+        return P(tp_axis)
+    return P()
+
+
+def param_shardings(mesh: Mesh, params: Any, tp_axis: str = "tp") -> Any:
+    """NamedSharding pytree for a params pytree under the tp rule."""
+    def rule(path, leaf):
+        pstr = jax.tree_util.keystr(path)
+        return NamedSharding(mesh, _tp_spec(pstr, leaf, mesh, tp_axis))
+    return jax.tree_util.tree_map_with_path(rule, params)
+
+
+def shard_params(mesh: Mesh, params: Any, tp_axis: str = "tp") -> Any:
+    """device_put a params pytree with the tp rule applied."""
+    shardings = param_shardings(mesh, params, tp_axis)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(jnp.asarray(x), s), params, shardings)
+
+
+def shard_batch(mesh: Mesh, batch: Any, axis: str = "dp",
+                seq_axis: Optional[str] = None) -> Any:
+    """device_put batch arrays sharded over the dp (and optionally sp) axis."""
+    def put(x):
+        if x is None:
+            return None
+        x = jnp.asarray(x)
+        spec = [axis] + ([seq_axis] if seq_axis and x.ndim > 1 else [])
+        spec = spec[: x.ndim]
+        return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+    return jax.tree_util.tree_map(put, batch)

@@ -9,6 +9,8 @@ MUST run before the first `import jax` anywhere in the test session.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# the axon TPU plugin ignores JAX_PLATFORMS; the legacy var does force cpu
+os.environ["JAX_PLATFORM_NAME"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
