@@ -1,0 +1,48 @@
+"""ModelGuesser: load a model or config from a file by sniffing its kind
+(ref: deeplearning4j-core/.../util/ModelGuesser.java)."""
+
+from __future__ import annotations
+
+import json
+import zipfile
+
+
+class ModelGuesser:
+    @staticmethod
+    def load_model_guess(path):
+        """Return a network (MLN or ComputationGraph) or a bare config,
+        whatever the file holds."""
+        from deeplearning4j_tpu.util.model_serializer import (
+            META_ENTRY,
+            restore_computation_graph,
+            restore_multi_layer_network,
+        )
+
+        if zipfile.is_zipfile(path):
+            with zipfile.ZipFile(path) as z:
+                names = set(z.namelist())
+                if META_ENTRY in names:
+                    meta = json.loads(z.read(META_ENTRY).decode())
+                    if meta.get("model_type") == "ComputationGraph":
+                        return restore_computation_graph(path)
+                    return restore_multi_layer_network(path)
+            return restore_multi_layer_network(path)
+        # plain JSON config?
+        with open(path) as f:
+            d = json.load(f)
+        return ModelGuesser.load_config_guess_dict(d)
+
+    @staticmethod
+    def load_config_guess(path):
+        with open(path) as f:
+            return ModelGuesser.load_config_guess_dict(json.load(f))
+
+    @staticmethod
+    def load_config_guess_dict(d: dict):
+        if "vertices" in d or "network_inputs" in d:
+            from deeplearning4j_tpu.nn.conf.graph_conf import (
+                ComputationGraphConfiguration,
+            )
+            return ComputationGraphConfiguration.from_dict(d)
+        from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+        return MultiLayerConfiguration.from_dict(d)

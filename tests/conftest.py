@@ -18,6 +18,11 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 import jax  # noqa: E402
+
+# A sitecustomize pre-imports jax before this file runs, so the env vars
+# above can be too late for platform selection; the config API still works
+# as long as no backend has been initialized yet.
+jax.config.update("jax_platform_name", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -1,0 +1,156 @@
+"""Gradient checks per layer family (ref: deeplearning4j-core
+gradientcheck/ suites — GradientCheckTests.java, CNNGradientCheckTest,
+LSTMGradientCheckTests, VaeGradientCheckTests, GradientCheckTestsMasking)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.gradientcheck import check_gradients
+from deeplearning4j_tpu.nn.conf import InputType
+from deeplearning4j_tpu.nn.layers import (
+    AutoEncoder,
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    EmbeddingLayer,
+    GlobalPoolingLayer,
+    GravesBidirectionalLSTM,
+    GravesLSTM,
+    LossLayer,
+    OutputLayer,
+    RnnOutputLayer,
+    SubsamplingLayer,
+    VariationalAutoencoder,
+)
+
+
+@pytest.fixture(autouse=True)
+def x64():
+    with jax.enable_x64(True):
+        yield
+
+
+def _check(layers, input_type, x, y, fmask=None, lmask=None, **kw):
+    b = NeuralNetConfiguration.Builder().seed(3).updater("sgd") \
+        .learning_rate(0.1).activation("tanh").weight_init("xavier").list()
+    for l in layers:
+        b = b.layer(l)
+    conf = b.set_input_type(input_type).build()
+    net = MultiLayerNetwork(conf, dtype=jnp.float64).init()
+    assert check_gradients(net, x, y, fmask=fmask, lmask=lmask, **kw)
+
+
+def _cls(rng, n, c):
+    return np.eye(c)[rng.integers(0, c, n)]
+
+
+def test_dense_mlp(rng):
+    x = rng.normal(size=(5, 4))
+    y = _cls(rng, 5, 3)
+    _check([DenseLayer(n_out=6), OutputLayer(n_out=3, loss="mcxent")],
+           InputType.feed_forward(4), x, y)
+
+
+def test_dense_l1_l2(rng):
+    x = rng.normal(size=(4, 4))
+    y = _cls(rng, 4, 3)
+    b = NeuralNetConfiguration.Builder().seed(3).updater("sgd") \
+        .learning_rate(0.1).activation("sigmoid").weight_init("xavier") \
+        .l1(0.01).l2(0.02).list() \
+        .layer(DenseLayer(n_out=5)) \
+        .layer(OutputLayer(n_out=3, loss="mcxent"))
+    conf = b.set_input_type(InputType.feed_forward(4)).build()
+    net = MultiLayerNetwork(conf, dtype=jnp.float64).init()
+    assert check_gradients(net, x, y)
+
+
+def test_cnn_pool_bn(rng):
+    x = rng.normal(size=(3, 8, 8, 2))
+    y = _cls(rng, 3, 4)
+    _check([
+        ConvolutionLayer(n_out=3, kernel_size=(3, 3), convolution_mode="same"),
+        BatchNormalization(),
+        SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)),
+        OutputLayer(n_out=4, loss="mcxent"),
+    ], InputType.convolutional(8, 8, 2), x, y, subset=40)
+
+
+def test_cnn_avg_pool(rng):
+    x = rng.normal(size=(3, 6, 6, 1))
+    y = _cls(rng, 3, 2)
+    _check([
+        ConvolutionLayer(n_out=2, kernel_size=(2, 2), stride=(2, 2)),
+        SubsamplingLayer(pooling_type="avg", kernel_size=(3, 3), stride=(1, 1)),
+        OutputLayer(n_out=2, loss="mcxent"),
+    ], InputType.convolutional(6, 6, 1), x, y, subset=40)
+
+
+def test_lstm_rnn_output(rng):
+    x = rng.normal(size=(3, 6, 4))
+    y = np.stack([_cls(rng, 6, 3) for _ in range(3)])
+    _check([GravesLSTM(n_out=5), RnnOutputLayer(n_out=3, loss="mcxent")],
+           InputType.recurrent(4, 6), x, y, subset=40)
+
+
+def test_bidirectional_lstm(rng):
+    x = rng.normal(size=(2, 5, 3))
+    y = np.stack([_cls(rng, 5, 2) for _ in range(2)])
+    _check([GravesBidirectionalLSTM(n_out=4),
+            RnnOutputLayer(n_out=2, loss="mcxent")],
+           InputType.recurrent(3, 5), x, y, subset=30)
+
+
+def test_lstm_masking(rng):
+    x = rng.normal(size=(3, 6, 4))
+    y = np.stack([_cls(rng, 6, 3) for _ in range(3)])
+    lmask = np.ones((3, 6))
+    lmask[0, 4:] = 0.0
+    lmask[2, 2:] = 0.0
+    _check([GravesLSTM(n_out=4), RnnOutputLayer(n_out=3, loss="mcxent")],
+           InputType.recurrent(4, 6), x, y, lmask=lmask, subset=30)
+
+
+def test_global_pooling_rnn(rng):
+    x = rng.normal(size=(3, 5, 4))
+    y = _cls(rng, 3, 3)
+    _check([GravesLSTM(n_out=4), GlobalPoolingLayer(pooling_type="max"),
+            OutputLayer(n_out=3, loss="mcxent")],
+           InputType.recurrent(4, 5), x, y, subset=30)
+
+
+def test_embedding(rng):
+    x = rng.integers(0, 7, size=(5, 1)).astype(np.float64)
+    y = _cls(rng, 5, 3)
+    _check([EmbeddingLayer(n_out=4), DenseLayer(n_out=5),
+            OutputLayer(n_out=3, loss="mcxent")],
+           InputType.feed_forward(7), x, y)
+
+
+def test_regression_losses(rng):
+    for loss in ["mse", "l1", "xent"]:
+        x = rng.normal(size=(4, 3))
+        y = (rng.uniform(size=(4, 2)) if loss == "xent"
+             else rng.normal(size=(4, 2)))
+        act = "sigmoid" if loss == "xent" else "identity"
+        _check([DenseLayer(n_out=5),
+                OutputLayer(n_out=2, loss=loss, activation=act)],
+               InputType.feed_forward(3), x, y)
+
+
+def test_autoencoder_supervised(rng):
+    x = rng.normal(size=(4, 6))
+    y = _cls(rng, 4, 2)
+    _check([AutoEncoder(n_out=4), OutputLayer(n_out=2, loss="mcxent")],
+           InputType.feed_forward(6), x, y)
+
+
+def test_vae_supervised(rng):
+    x = rng.normal(size=(4, 6))
+    y = _cls(rng, 4, 2)
+    _check([VariationalAutoencoder(n_out=3, encoder_layer_sizes=(8,),
+                                   decoder_layer_sizes=(8,)),
+            OutputLayer(n_out=2, loss="mcxent")],
+           InputType.feed_forward(6), x, y, subset=30)
