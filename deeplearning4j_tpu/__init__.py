@@ -21,3 +21,6 @@ from deeplearning4j_tpu.nn.conf import (  # noqa: F401
     MultiLayerConfiguration,
 )
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: F401
+
+from deeplearning4j_tpu.nn.conf import ComputationGraphConfiguration  # noqa: F401,E402
+from deeplearning4j_tpu.nn.graph import ComputationGraph  # noqa: F401,E402

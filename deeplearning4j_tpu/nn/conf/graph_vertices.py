@@ -1,0 +1,374 @@
+"""Graph vertex types for ComputationGraph.
+
+Parity: nn/conf/graph/ — ElementWiseVertex, MergeVertex, SubsetVertex,
+L2NormalizeVertex, L2Vertex, ScaleVertex, ShiftVertex, StackVertex,
+UnstackVertex, ReshapeVertex, PoolHelperVertex, PreprocessorVertex,
+plus rnn/ (LastTimeStepVertex, DuplicateToTimeSeriesVertex). The
+reference's LayerVertex is implicit: layers are added to the graph
+directly (GraphBuilder.add_layer).
+
+A vertex is a stateless pure function over its input arrays — no params —
+so it is just `apply(inputs) -> array` + shape inference + serde.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import (
+    InputType,
+    InputTypeConvolutional,
+    InputTypeFeedForward,
+    InputTypeRecurrent,
+)
+
+_VERTEX_REGISTRY = {}
+
+
+def register_vertex(cls):
+    _VERTEX_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def vertex_from_dict(d: dict):
+    d = dict(d)
+    kind = d.pop("type")
+    if kind not in _VERTEX_REGISTRY:
+        raise ValueError(
+            f"Unknown vertex type '{kind}'. "
+            f"Registered: {sorted(_VERTEX_REGISTRY)}")
+    if kind == "PreprocessorVertex" and isinstance(d.get("preprocessor"), dict):
+        from deeplearning4j_tpu.nn.conf.preprocessors import (
+            preprocessor_from_dict,
+        )
+        d["preprocessor"] = preprocessor_from_dict(d["preprocessor"])
+    return _VERTEX_REGISTRY[kind](**d)
+
+
+@dataclass
+class GraphVertex:
+    def n_inputs(self):  # (min, max) accepted input count
+        return (1, 1)
+
+    def output_type(self, input_types: List[InputType]) -> InputType:
+        return input_types[0]
+
+    def apply(self, inputs: Sequence[jnp.ndarray]) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def feed_forward_mask(self, masks, input_types):
+        """Combine/propagate input masks; default: first non-None."""
+        for m in masks:
+            if m is not None:
+                return m
+        return None
+
+    def to_dict(self) -> dict:
+        d = {"type": type(self).__name__}
+        for f in dataclasses.fields(self):
+            d[f.name] = getattr(self, f.name)
+        return d
+
+
+def _same_types(input_types):
+    first = input_types[0]
+    for t in input_types[1:]:
+        if t.to_dict() != first.to_dict():
+            raise ValueError(
+                f"vertex inputs must have identical types, got {input_types}")
+    return first
+
+
+@register_vertex
+@dataclass
+class ElementWiseVertex(GraphVertex):
+    """Pointwise add/average/subtract/product/max over same-shaped inputs
+    (ref: nn/conf/graph/ElementWiseVertex.java)."""
+
+    op: str = "add"
+
+    def n_inputs(self):
+        return (2, None) if self.op != "subtract" else (2, 2)
+
+    def output_type(self, input_types):
+        return _same_types(input_types)
+
+    def apply(self, inputs):
+        op = self.op.lower()
+        if op == "add":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out + x
+            return out
+        if op == "average":
+            return sum(inputs) / len(inputs)
+        if op == "subtract":
+            return inputs[0] - inputs[1]
+        if op == "product":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out * x
+            return out
+        if op == "max":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = jnp.maximum(out, x)
+            return out
+        raise ValueError(f"Unknown ElementWiseVertex op '{self.op}'")
+
+
+@register_vertex
+@dataclass
+class MergeVertex(GraphVertex):
+    """Concatenate along the feature/channel axis
+    (ref: nn/conf/graph/MergeVertex.java)."""
+
+    def n_inputs(self):
+        return (1, None)
+
+    def output_type(self, input_types):
+        first = input_types[0]
+        if isinstance(first, InputTypeFeedForward):
+            return InputType.feed_forward(
+                sum(t.size for t in input_types))
+        if isinstance(first, InputTypeRecurrent):
+            return InputType.recurrent(
+                sum(t.size for t in input_types), first.timeseries_length)
+        if isinstance(first, InputTypeConvolutional):
+            for t in input_types[1:]:
+                if (t.height, t.width) != (first.height, first.width):
+                    raise ValueError(
+                        f"MergeVertex conv inputs must share HxW: {input_types}")
+            return InputType.convolutional(
+                first.height, first.width,
+                sum(t.channels for t in input_types))
+        raise ValueError(f"MergeVertex: unsupported input type {first}")
+
+    def apply(self, inputs):
+        return jnp.concatenate(inputs, axis=-1)
+
+
+@register_vertex
+@dataclass
+class SubsetVertex(GraphVertex):
+    """Feature-range slice [from, to] inclusive
+    (ref: nn/conf/graph/SubsetVertex.java)."""
+
+    from_index: int = 0
+    to_index: int = 0
+
+    def output_type(self, input_types):
+        n = self.to_index - self.from_index + 1
+        t = input_types[0]
+        if isinstance(t, InputTypeRecurrent):
+            return InputType.recurrent(n, t.timeseries_length)
+        if isinstance(t, InputTypeConvolutional):
+            return InputType.convolutional(t.height, t.width, n)
+        return InputType.feed_forward(n)
+
+    def apply(self, inputs):
+        return inputs[0][..., self.from_index:self.to_index + 1]
+
+
+@register_vertex
+@dataclass
+class L2NormalizeVertex(GraphVertex):
+    """x / ||x||_2 over all non-batch dims
+    (ref: nn/conf/graph/L2NormalizeVertex.java)."""
+
+    eps: float = 1e-8
+
+    def apply(self, inputs):
+        x = inputs[0]
+        axes = tuple(range(1, x.ndim))
+        norm = jnp.sqrt(jnp.sum(x * x, axis=axes, keepdims=True))
+        return x / (norm + self.eps)
+
+
+@register_vertex
+@dataclass
+class L2Vertex(GraphVertex):
+    """Pairwise L2 distance between two inputs -> [batch, 1]
+    (ref: nn/conf/graph/L2Vertex.java)."""
+
+    eps: float = 1e-8
+
+    def n_inputs(self):
+        return (2, 2)
+
+    def output_type(self, input_types):
+        return InputType.feed_forward(1)
+
+    def apply(self, inputs):
+        a, b = inputs
+        d = (a - b).reshape(a.shape[0], -1)
+        return jnp.sqrt(jnp.sum(d * d, axis=1, keepdims=True) + self.eps)
+
+
+@register_vertex
+@dataclass
+class ScaleVertex(GraphVertex):
+    """x * scale_factor (ref: nn/conf/graph/ScaleVertex.java)."""
+
+    scale_factor: float = 1.0
+
+    def apply(self, inputs):
+        return inputs[0] * self.scale_factor
+
+
+@register_vertex
+@dataclass
+class ShiftVertex(GraphVertex):
+    """x + shift_factor (ref: nn/conf/graph/ShiftVertex.java)."""
+
+    shift_factor: float = 0.0
+
+    def apply(self, inputs):
+        return inputs[0] + self.shift_factor
+
+
+@register_vertex
+@dataclass
+class StackVertex(GraphVertex):
+    """Stack N inputs along the batch dim (ref: nn/conf/graph/StackVertex.java)."""
+
+    def n_inputs(self):
+        return (2, None)
+
+    def output_type(self, input_types):
+        return _same_types(input_types)
+
+    def apply(self, inputs):
+        return jnp.concatenate(inputs, axis=0)
+
+
+@register_vertex
+@dataclass
+class UnstackVertex(GraphVertex):
+    """Take slice `from_index` of `stack_size` equal batch chunks
+    (ref: nn/conf/graph/UnstackVertex.java)."""
+
+    from_index: int = 0
+    stack_size: int = 1
+
+    def apply(self, inputs):
+        x = inputs[0]
+        n = x.shape[0] // self.stack_size
+        return x[self.from_index * n:(self.from_index + 1) * n]
+
+
+@register_vertex
+@dataclass
+class ReshapeVertex(GraphVertex):
+    """Reshape non-batch dims (ref: nn/conf/graph/ReshapeVertex.java).
+    new_shape excludes the batch dim."""
+
+    new_shape: Sequence[int] = ()
+
+    def output_type(self, input_types):
+        s = tuple(self.new_shape)
+        if len(s) == 1:
+            return InputType.feed_forward(s[0])
+        if len(s) == 2:
+            return InputType.recurrent(s[1], s[0])
+        if len(s) == 3:
+            return InputType.convolutional(s[0], s[1], s[2])
+        raise ValueError(f"ReshapeVertex: bad new_shape {s}")
+
+    def apply(self, inputs):
+        x = inputs[0]
+        return x.reshape((x.shape[0],) + tuple(self.new_shape))
+
+
+@register_vertex
+@dataclass
+class PreprocessorVertex(GraphVertex):
+    """Wraps an InputPreProcessor as a standalone vertex
+    (ref: nn/conf/graph/PreprocessorVertex.java)."""
+
+    preprocessor: object = None
+
+    def output_type(self, input_types):
+        return self.preprocessor.output_type(input_types[0])
+
+    def apply(self, inputs):
+        return self.preprocessor.preprocess(inputs[0])
+
+    def to_dict(self):
+        return {"type": "PreprocessorVertex",
+                "preprocessor": self.preprocessor.to_dict()}
+
+
+@register_vertex
+@dataclass
+class PoolHelperVertex(GraphVertex):
+    """Strips the first row/column of a conv activation — compatibility
+    shim for GoogLeNet-style imports (ref: nn/conf/graph/PoolHelperVertex.java)."""
+
+    def output_type(self, input_types):
+        t = input_types[0]
+        return InputType.convolutional(t.height - 1, t.width - 1, t.channels)
+
+    def apply(self, inputs):
+        return inputs[0][:, 1:, 1:, :]
+
+
+@register_vertex
+@dataclass
+class LastTimeStepVertex(GraphVertex):
+    """[B,T,C] -> [B,C] at the last *unmasked* step per example
+    (ref: nn/conf/graph/rnn/LastTimeStepVertex.java). mask_input names the
+    network input whose mask to use."""
+
+    mask_input: Optional[str] = None
+
+    def output_type(self, input_types):
+        t = input_types[0]
+        return InputType.feed_forward(t.size)
+
+    def apply(self, inputs, mask=None):
+        x = inputs[0]
+        if mask is None:
+            return x[:, -1, :]
+        idx = jnp.maximum(
+            jnp.sum(mask > 0, axis=1).astype(jnp.int32) - 1, 0)
+        return x[jnp.arange(x.shape[0]), idx, :]
+
+    def feed_forward_mask(self, masks, input_types):
+        return None  # output is not a time series
+
+
+@register_vertex
+@dataclass
+class DuplicateToTimeSeriesVertex(GraphVertex):
+    """[B,C] -> [B,T,C] by broadcasting over the time length of a named
+    node/input (ref: nn/conf/graph/rnn/DuplicateToTimeSeriesVertex.java).
+    GraphBuilder wires `ts_input` in as an implicit second input edge, so
+    apply() always receives the reference time-series array."""
+
+    ts_input: Optional[str] = None
+
+    def n_inputs(self):
+        return (2, 2)
+
+    def output_type(self, input_types):
+        t0 = input_types[0]
+        ts_len = None
+        for t in input_types[1:]:
+            if isinstance(t, InputTypeRecurrent):
+                ts_len = t.timeseries_length
+        return InputType.recurrent(t0.size, ts_len)
+
+    def apply(self, inputs):
+        x = inputs[0]
+        if len(inputs) > 1:
+            T = inputs[1].shape[1]
+        else:
+            raise ValueError(
+                "DuplicateToTimeSeriesVertex needs the reference time-series "
+                "array as its second input")
+        return jnp.broadcast_to(x[:, None, :], (x.shape[0], T, x.shape[1]))

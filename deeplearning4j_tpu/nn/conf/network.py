@@ -248,6 +248,13 @@ class NeuralNetConfiguration:
         def list(self) -> "NeuralNetConfiguration.ListBuilder":
             return NeuralNetConfiguration.ListBuilder(self)
 
+        def graph_builder(self):
+            """DAG variant (ref: NeuralNetConfiguration.Builder.graphBuilder())."""
+            from deeplearning4j_tpu.nn.conf.graph_conf import GraphBuilder
+            return GraphBuilder(self)
+
+        graphBuilder = graph_builder
+
     class ListBuilder:
         def __init__(self, builder: "NeuralNetConfiguration.Builder"):
             self._builder = builder

@@ -1,0 +1,445 @@
+"""ComputationGraph: the DAG network container.
+
+Parity: nn/graph/ComputationGraph.java (3,159 LoC) — topo-sorted vertex
+execution (topologicalOrder :144, init :364), fit(DataSetIterator) :787,
+fit(MultiDataSetIterator) :907, computeGradientAndScore :1213,
+rnnTimeStep :2269. Vertex impls: nn/graph/vertex/impl/.
+
+TPU-native design mirrors MultiLayerNetwork: params are a dict
+name -> pytree, the whole forward+backward+update is one jit-compiled XLA
+program, gradients via jax.grad over the summed multi-output loss.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.graph_conf import (
+    ComputationGraphConfiguration,
+    GraphNode,
+)
+from deeplearning4j_tpu.nn.conf.graph_vertices import LastTimeStepVertex
+from deeplearning4j_tpu.nn.layers.core import BaseOutputLayer
+from deeplearning4j_tpu.nn.layers.recurrent import (
+    LSTM,
+    GravesBidirectionalLSTM,
+)
+from deeplearning4j_tpu.nn.updater import get_updater, schedule_lr
+
+
+def _as_multi(data) -> Tuple[List, List, Optional[List], Optional[List]]:
+    """Normalize to (inputs, labels, input_masks, label_masks) lists.
+    Accepts MultiDataSet-like objects, (x, y) with arrays or lists."""
+    if hasattr(data, "features"):
+        f, l = data.features, data.labels
+        fm = getattr(data, "features_mask", None)
+        lm = getattr(data, "labels_mask", None)
+        as_list = lambda v: (list(v) if isinstance(v, (list, tuple)) else
+                             [v]) if v is not None else None
+        return as_list(f), as_list(l), as_list(fm), as_list(lm)
+    if isinstance(data, (tuple, list)):
+        x = data[0]
+        y = data[1] if len(data) > 1 else None
+        fm = data[2] if len(data) > 2 else None
+        lm = data[3] if len(data) > 3 else None
+        as_list = lambda v: (list(v) if isinstance(v, (list, tuple))
+                             else [v]) if v is not None else None
+        return as_list(x), as_list(y), as_list(fm), as_list(lm)
+    return [data], None, None, None
+
+
+class ComputationGraph:
+    def __init__(self, conf: ComputationGraphConfiguration,
+                 dtype=jnp.float32):
+        if not conf.nodes:
+            raise ValueError("Configuration has no nodes")
+        self.conf = conf
+        self.dtype = dtype
+        self.topo: List[GraphNode] = conf.topological_order()
+        self.node_types = None
+        self._layer_in_types = None
+        if conf.input_types:
+            self.node_types, self._layer_in_types = conf.resolve_shapes(
+                return_layer_inputs=True)
+        self.params: Optional[Dict[str, Any]] = None
+        self.states: Optional[Dict[str, Any]] = None
+        self.updater_states: Optional[Dict[str, Any]] = None
+        self.rnn_states: Optional[Dict[str, Any]] = None
+        self.iteration = 0
+        self.epoch = 0
+        self._score = None
+        self.listeners: List = []
+        self._rng = None
+        self._jit_cache: Dict[str, Any] = {}
+        self._updaters: Optional[Dict[str, Any]] = None
+
+    # ------------------------------------------------------------------ init
+    def init(self, seed: Optional[int] = None) -> "ComputationGraph":
+        if self.node_types is None:
+            raise ValueError("set input types on the configuration "
+                             "before init()")
+        seed = self.conf.seed if seed is None else seed
+        key = jax.random.PRNGKey(seed)
+        self._rng = jax.random.fold_in(key, 0xBEEF)
+        self.params = {}
+        self.states = {}
+        layer_nodes = [n for n in self.topo if n.kind == "layer"]
+        keys = jax.random.split(key, max(len(layer_nodes), 1))
+        for node, k in zip(layer_nodes, keys):
+            t = self._layer_in_types[node.name]
+            self.params[node.name] = node.obj.init_params(k, t, self.dtype)
+            self.states[node.name] = node.obj.init_state(t, self.dtype)
+        self._init_updaters()
+        self.clear_rnn_state()
+        return self
+
+    def _init_updaters(self):
+        self._updaters = {}
+        self.updater_states = {}
+        for node in self.topo:
+            if node.kind != "layer":
+                continue
+            upd = get_updater(node.obj.updater or self.conf.updater,
+                              self.conf)
+            self._updaters[node.name] = upd
+            self.updater_states[node.name] = upd.init(self.params[node.name])
+
+    def num_params(self) -> int:
+        return sum(int(np.prod(l.shape))
+                   for l in jax.tree_util.tree_leaves(self.params))
+
+    # --------------------------------------------------------------- forward
+    def _forward(self, params, states, inputs: Dict[str, Any], *, train,
+                 rng, input_masks: Optional[Dict[str, Any]] = None,
+                 rnn_carries: Optional[Dict[str, Any]] = None):
+        """Pure forward over the DAG. Returns (activations dict,
+        new_states, new_carries)."""
+        acts: Dict[str, Any] = dict(inputs)
+        masks: Dict[str, Any] = dict(input_masks or {})
+        new_states: Dict[str, Any] = {}
+        new_carries: Dict[str, Any] = {}
+        if rng is not None:
+            rngs = jax.random.split(rng, max(len(self.topo), 1))
+        else:
+            rngs = [None] * len(self.topo)
+        for i, node in enumerate(self.topo):
+            xs = [acts[s] for s in node.inputs]
+            in_masks = [masks.get(s) for s in node.inputs]
+            if node.kind == "layer":
+                x = xs[0]
+                m = in_masks[0]
+                if node.preprocessor is not None:
+                    x = node.preprocessor.preprocess(x)
+                    m = node.preprocessor.feed_forward_mask(m, None)
+                layer = node.obj
+                is_rnn = isinstance(layer, (LSTM, GravesBidirectionalLSTM))
+                if is_rnn:
+                    carry = (None if rnn_carries is None
+                             else rnn_carries.get(node.name))
+                    out, nc = layer.apply(params[node.name], x, train=train,
+                                          rng=rngs[i], state=carry, mask=m)
+                    new_carries[node.name] = nc
+                    new_states[node.name] = states[node.name]
+                else:
+                    st = states[node.name] if states[node.name] else None
+                    out, ns = layer.apply(params[node.name], x, train=train,
+                                          rng=rngs[i], state=st, mask=m)
+                    new_states[node.name] = (ns if ns is not None
+                                             else states[node.name])
+                acts[node.name] = out
+                masks[node.name] = layer.feed_forward_mask(m, None)
+            else:
+                v = node.obj
+                if isinstance(v, LastTimeStepVertex):
+                    m = (masks.get(v.mask_input)
+                         if v.mask_input else in_masks[0])
+                    acts[node.name] = v.apply(xs, mask=m)
+                else:
+                    acts[node.name] = v.apply(xs)
+                masks[node.name] = v.feed_forward_mask(in_masks, None)
+        return acts, new_states, new_carries
+
+    # ------------------------------------------------------------------ loss
+    def _output_layer_nodes(self) -> List[GraphNode]:
+        return [self.conf.node(n) for n in self.conf.network_outputs]
+
+    def _loss_fn(self, params, states, inputs, labels, rng,
+                 input_masks=None, label_masks=None, rnn_carries=None,
+                 train=True):
+        """Sum of output-layer losses + regularization
+        (ref: ComputationGraph.computeGradientAndScore :1213)."""
+        conf = self.conf
+        # run DAG up to each output's pre-activation: we re-run full DAG and
+        # recompute output layer pre_output from its input activation
+        out_nodes = self._output_layer_nodes()
+        for n in out_nodes:
+            if n.kind != "layer" or not isinstance(n.obj, BaseOutputLayer):
+                raise ValueError(
+                    f"network output '{n.name}' must be an output layer "
+                    f"to train; got {type(n.obj).__name__}")
+        acts, new_states, new_carries = self._forward(
+            params, states, inputs, train=train, rng=rng,
+            input_masks=input_masks, rnn_carries=rnn_carries)
+        total = 0.0
+        for oi, node in enumerate(out_nodes):
+            # recompute the output layer's per-example loss from its input
+            src = node.inputs[0]
+            x = acts[src]
+            if node.preprocessor is not None:
+                x = node.preprocessor.preprocess(x)
+            layer = node.obj
+            y = labels[oi]
+            lm = None if label_masks is None else label_masks[oi]
+            pre = layer.pre_output(params[node.name], x)
+            per_ex = layer.compute_per_example_loss(y, pre, mask=lm)
+            if lm is not None:
+                active = lm if lm.ndim == 1 else jnp.any(lm > 0, axis=1)
+                s = jnp.sum(per_ex)
+                total = total + (s / jnp.maximum(jnp.sum(active), 1.0)
+                                 if conf.minibatch else s)
+            elif conf.minibatch:
+                total = total + jnp.mean(per_ex)
+            else:
+                total = total + jnp.sum(per_ex)
+        reg = 0.0
+        for node in self.topo:
+            if node.kind == "layer":
+                reg = reg + node.obj.regularization_loss(params[node.name])
+        return total + reg, (new_states, new_carries)
+
+    # ------------------------------------------------------------ train step
+    def _clip_grads(self, grads):
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        return MultiLayerNetwork._clip_grads(self, grads)  # same logic
+
+    def _build_train_step(self, with_carries: bool):
+        conf = self.conf
+        updaters = self._updaters
+        layer_names = [n.name for n in self.topo if n.kind == "layer"]
+        lr_factors = {
+            n.name: ((n.obj.learning_rate / conf.learning_rate)
+                     if getattr(n.obj, "learning_rate", None) is not None
+                     and conf.learning_rate != 0 else 1.0)
+            for n in self.topo if n.kind == "layer"
+        }
+
+        def step_fn(params, upd_states, states, step, inputs, labels,
+                    fmasks, lmasks, rng, carries):
+            (loss, (new_states, new_carries)), grads = jax.value_and_grad(
+                self._loss_fn, has_aux=True)(
+                    params, states, inputs, labels, rng, fmasks, lmasks,
+                    rnn_carries=carries if with_carries else None)
+            grads = self._clip_grads(grads)
+            lr = schedule_lr(conf, step)
+            new_params = {}
+            new_upd = {}
+            for name in layer_names:
+                deltas, us = updaters[name].update(
+                    grads[name], upd_states[name], params[name],
+                    lr * lr_factors[name], step)
+                new_params[name] = jax.tree_util.tree_map(
+                    lambda p, d: p + d, params[name], deltas)
+                new_upd[name] = us
+            return new_params, new_upd, new_states, new_carries, loss
+
+        return jax.jit(step_fn, donate_argnums=(0, 1, 2))
+
+    def _train_step(self, inputs, labels, fmasks=None, lmasks=None,
+                    carries=None):
+        key = "train_c" if carries is not None else "train"
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self._build_train_step(carries is not None)
+        self._rng, sub = jax.random.split(self._rng)
+        (self.params, self.updater_states, self.states, new_carries,
+         loss) = self._jit_cache[key](
+            self.params, self.updater_states, self.states,
+            jnp.asarray(self.iteration, jnp.int32), inputs, labels,
+            fmasks, lmasks, sub, carries)
+        self.iteration += 1
+        self._score = loss
+        return loss, new_carries
+
+    # ------------------------------------------------------------------- fit
+    def fit(self, data, labels=None, epochs: int = 1):
+        """Train on a MultiDataSet iterator / list of batches / single batch
+        (ref: ComputationGraph.fit :787/:907)."""
+        if self.params is None:
+            self.init()
+        if labels is not None:
+            batches: Sequence = [(data, labels)]
+        elif isinstance(data, tuple):
+            batches = [data]
+        elif hasattr(data, "__iter__") and not hasattr(data, "features"):
+            batches = data
+            if epochs > 1 and iter(batches) is batches and not hasattr(
+                    batches, "reset"):
+                raise ValueError(
+                    "fit() got a one-shot iterator with epochs > 1; pass a "
+                    "list or an iterator with reset()")
+        else:
+            batches = [data]
+
+        for _ in range(epochs):
+            for listener in self.listeners:
+                if hasattr(listener, "on_epoch_start"):
+                    listener.on_epoch_start(self)
+            if hasattr(batches, "reset"):
+                batches.reset()
+            for batch in batches:
+                ins, labs, fms, lms = _as_multi(batch)
+                self._fit_one(ins, labs, fms, lms)
+                for listener in self.listeners:
+                    listener.iteration_done(self, self.iteration)
+            self.epoch += 1
+            for listener in self.listeners:
+                if hasattr(listener, "on_epoch_end"):
+                    listener.on_epoch_end(self)
+        return self
+
+    def _fit_one(self, ins, labs, fms, lms):
+        from deeplearning4j_tpu.nn.conf.network import BackpropType
+
+        conf = self.conf
+        if labs is None:
+            raise ValueError("fit needs labels")
+        inputs = {name: jnp.asarray(x, self.dtype)
+                  for name, x in zip(conf.network_inputs, ins)}
+        labels = [jnp.asarray(y, self.dtype) for y in labs]
+        fmasks = None
+        if fms is not None:
+            fmasks = {name: (None if m is None else jnp.asarray(m, self.dtype))
+                      for name, m in zip(conf.network_inputs, fms)}
+        lmasks = None
+        if lms is not None:
+            lmasks = [None if m is None else jnp.asarray(m, self.dtype)
+                      for m in lms]
+        if (conf.backprop_type == BackpropType.TRUNCATED_BPTT
+                and all(x.ndim == 3 for x in inputs.values())):
+            self._fit_tbptt(inputs, labels, fmasks, lmasks)
+        else:
+            self._train_step(inputs, labels, fmasks, lmasks)
+
+    def _fit_tbptt(self, inputs, labels, fmasks, lmasks):
+        """Truncated BPTT over the DAG: slice every 3-D input/label on the
+        time axis into fwd-length chunks, carry RNN state across chunks
+        (ref: ComputationGraph's TBPTT path mirrors MLN
+        truncatedBPTTGradient :1395)."""
+        T = next(iter(inputs.values())).shape[1]
+        L = self.conf.tbptt_fwd_length
+        batch = next(iter(inputs.values())).shape[0]
+        carries = self._initial_carries(batch)
+        for start in range(0, T, L):
+            end = min(start + L, T)
+            sl = lambda a: a[:, start:end] if a is not None and a.ndim >= 2 \
+                and a.shape[1] == T else a
+            ins = {k: sl(v) for k, v in inputs.items()}
+            labs = [y[:, start:end] if y.ndim == 3 else y for y in labels]
+            fms = (None if fmasks is None
+                   else {k: sl(v) for k, v in fmasks.items()})
+            lms = (None if lmasks is None else [sl(m) for m in lmasks])
+            _, carries = self._train_step(ins, labs, fms, lms,
+                                          carries=carries)
+            carries = jax.lax.stop_gradient(carries)
+
+    # ------------------------------------------------------------- inference
+    def output(self, *xs, train: bool = False):
+        """Forward pass; returns the output-node activations (single array
+        if one output)."""
+        conf = self.conf
+        if len(xs) == 1 and isinstance(xs[0], (list, tuple)):
+            xs = tuple(xs[0])
+        inputs = {name: jnp.asarray(x, self.dtype)
+                  for name, x in zip(conf.network_inputs, xs)}
+        if "predict" not in self._jit_cache:
+            def predict_fn(params, states, inputs):
+                acts, _, _ = self._forward(params, states, inputs,
+                                           train=False, rng=None)
+                return [acts[n] for n in self.conf.network_outputs]
+            self._jit_cache["predict"] = jax.jit(predict_fn)
+        outs = self._jit_cache["predict"](self.params, self.states, inputs)
+        return outs[0] if len(outs) == 1 else outs
+
+    def feed_forward(self, *xs, train: bool = False):
+        """All activations dict name -> array."""
+        inputs = {name: jnp.asarray(x, self.dtype)
+                  for name, x in zip(self.conf.network_inputs, xs)}
+        acts, _, _ = self._forward(self.params, self.states, inputs,
+                                   train=train, rng=None)
+        return acts
+
+    def score(self, data=None):
+        if data is None:
+            return None if self._score is None else float(self._score)
+        ins, labs, fms, lms = _as_multi(data)
+        inputs = {name: jnp.asarray(x, self.dtype)
+                  for name, x in zip(self.conf.network_inputs, ins)}
+        labels = [jnp.asarray(y, self.dtype) for y in labs]
+        fmasks = None
+        if fms is not None:
+            fmasks = {name: (None if m is None else jnp.asarray(m))
+                      for name, m in zip(self.conf.network_inputs, fms)}
+        lmasks = (None if lms is None else
+                  [None if m is None else jnp.asarray(m) for m in lms])
+        loss, _ = self._loss_fn(self.params, self.states, inputs, labels,
+                                None, fmasks, lmasks, train=False)
+        return float(loss)
+
+    # --------------------------------------------------------- streaming RNN
+    def rnn_time_step(self, *xs):
+        """Stateful decoding (ref: ComputationGraph.rnnTimeStep :2269)."""
+        for node in self.topo:
+            if isinstance(node.obj, GravesBidirectionalLSTM):
+                raise ValueError(
+                    "rnn_time_step is not supported for bidirectional "
+                    "RNN layers; use output() on the full sequence")
+        inputs = {}
+        single = False
+        for name, x in zip(self.conf.network_inputs, xs):
+            x = jnp.asarray(x, self.dtype)
+            if x.ndim == 2:
+                single = True
+                x = x[:, None, :]
+            inputs[name] = x
+        if self.rnn_states is None or self.rnn_states == "uninit":
+            batch = next(iter(inputs.values())).shape[0]
+            self.rnn_states = self._initial_carries(batch)
+        acts, _, new_carries = self._forward(
+            self.params, self.states, inputs, train=False, rng=None,
+            rnn_carries=self.rnn_states)
+        for k, v in new_carries.items():
+            if v is not None:
+                self.rnn_states[k] = v
+        outs = [acts[n] for n in self.conf.network_outputs]
+        if single:
+            outs = [o[:, -1, :] if o.ndim == 3 else o for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+    def _initial_carries(self, batch_size):
+        carries = {}
+        for node in self.topo:
+            if isinstance(node.obj, GravesBidirectionalLSTM):
+                sub = node.obj._directional()
+                c = sub.initial_carry(batch_size, self.dtype)
+                carries[node.name] = (c, c)
+            elif isinstance(node.obj, LSTM):
+                carries[node.name] = node.obj.initial_carry(
+                    batch_size, self.dtype)
+        return carries
+
+    def clear_rnn_state(self):
+        self.rnn_states = "uninit"
+
+    # -------------------------------------------------------------- plumbing
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    def get_layer(self, name: str):
+        return self.conf.node(name).obj
+
+    def n_layers(self) -> int:
+        return sum(1 for n in self.topo if n.kind == "layer")

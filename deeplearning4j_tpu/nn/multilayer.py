@@ -214,16 +214,22 @@ class MultiLayerNetwork:
 
         if gn == "clip_element_wise_absolute_value":
             return tmap(lambda g: jnp.clip(g, -t, t), grads)
+        # per-layer modes: grads is a list (MLN) or dict (ComputationGraph)
+        # of per-layer pytrees
         if gn == "clip_l2_per_layer":
-            return [
-                tmap(lambda g, s=jnp.minimum(1.0, t / _layer_norm(lg)): g * s, lg)
-                for lg in grads
-            ]
+            def _clip(lg):
+                return tmap(
+                    lambda g, s=jnp.minimum(1.0, t / _layer_norm(lg)): g * s,
+                    lg)
+            if isinstance(grads, dict):
+                return {k: _clip(lg) for k, lg in grads.items()}
+            return [_clip(lg) for lg in grads]
         if gn == "renormalize_l2_per_layer":
-            return [
-                tmap(lambda g, s=1.0 / _layer_norm(lg): g * s, lg)
-                for lg in grads
-            ]
+            def _renorm(lg):
+                return tmap(lambda g, s=1.0 / _layer_norm(lg): g * s, lg)
+            if isinstance(grads, dict):
+                return {k: _renorm(lg) for k, lg in grads.items()}
+            return [_renorm(lg) for lg in grads]
         if gn == "clip_l2_per_param_type":
             return tmap(
                 lambda g: g * jnp.minimum(

@@ -1,0 +1,316 @@
+"""ComputationGraph tests: builder validation, vertex math, training,
+multi-input/multi-output, serde, gradient check (ref:
+GradientCheckTestsComputationGraph.java and graph vertex tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (
+    ComputationGraph,
+    ComputationGraphConfiguration,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.gradientcheck import check_gradients
+from deeplearning4j_tpu.nn.conf import (
+    ElementWiseVertex,
+    InputType,
+    L2NormalizeVertex,
+    L2Vertex,
+    LastTimeStepVertex,
+    MergeVertex,
+    ScaleVertex,
+    ShiftVertex,
+    StackVertex,
+    SubsetVertex,
+    UnstackVertex,
+)
+from deeplearning4j_tpu.nn.layers import (
+    DenseLayer,
+    GravesLSTM,
+    OutputLayer,
+    RnnOutputLayer,
+)
+
+
+def _builder():
+    return (NeuralNetConfiguration.Builder()
+            .seed(9).updater("sgd").learning_rate(0.1)
+            .activation("tanh").weight_init("xavier")
+            .graph_builder())
+
+
+def test_skip_connection_trains(rng):
+    conf = (_builder()
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_out=8), "in")
+            .add_layer("d2", DenseLayer(n_out=8), "d1")
+            .add_vertex("skip", ElementWiseVertex(op="add"), "d1", "d2")
+            .add_layer("out", OutputLayer(n_out=3, loss="mcxent"), "skip")
+            .set_outputs("out")
+            .set_input_types(**{"in": InputType.feed_forward(5)})
+            .build())
+    g = ComputationGraph(conf).init()
+    x = rng.normal(size=(32, 5)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+    s0 = g.score((x, y))
+    g.fit([(x, y)] * 20)
+    assert g.score((x, y)) < s0 * 0.8
+    assert np.asarray(g.output(x)).shape == (32, 3)
+
+
+def test_multi_input_multi_output(rng):
+    conf = (_builder()
+            .add_inputs("a", "b")
+            .add_layer("da", DenseLayer(n_out=6), "a")
+            .add_layer("db", DenseLayer(n_out=6), "b")
+            .add_layer("shared", DenseLayer(n_out=8), "da", "db")  # auto-merge
+            .add_layer("out1", OutputLayer(n_out=2, loss="mcxent"), "shared")
+            .add_layer("out2", OutputLayer(n_out=1, loss="mse",
+                                           activation="identity"), "shared")
+            .set_outputs("out1", "out2")
+            .set_input_types(a=InputType.feed_forward(4),
+                             b=InputType.feed_forward(3))
+            .build())
+    g = ComputationGraph(conf).init()
+    xa = rng.normal(size=(16, 4)).astype(np.float32)
+    xb = rng.normal(size=(16, 3)).astype(np.float32)
+    y1 = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+    y2 = rng.normal(size=(16, 1)).astype(np.float32)
+    g.fit([([xa, xb], [y1, y2])] * 3)
+    o1, o2 = g.output(xa, xb)
+    assert o1.shape == (16, 2) and o2.shape == (16, 1)
+
+
+def test_vertex_math():
+    a = jnp.asarray(np.arange(6, dtype=np.float32).reshape(2, 3))
+    b = jnp.ones((2, 3), jnp.float32)
+    assert np.allclose(ElementWiseVertex(op="add").apply([a, b]), a + 1)
+    assert np.allclose(ElementWiseVertex(op="subtract").apply([a, b]), a - 1)
+    assert np.allclose(ElementWiseVertex(op="product").apply([a, b]), a)
+    assert np.allclose(ElementWiseVertex(op="max").apply([a, b]),
+                       np.maximum(a, 1))
+    assert np.allclose(ElementWiseVertex(op="average").apply([a, b]),
+                       (a + b) / 2)
+    m = MergeVertex().apply([a, b])
+    assert m.shape == (2, 6)
+    s = SubsetVertex(from_index=1, to_index=2).apply([a])
+    assert np.allclose(s, np.asarray(a)[:, 1:3])
+    n = L2NormalizeVertex().apply([a])
+    assert np.allclose(np.linalg.norm(np.asarray(n[1])), 1.0, atol=1e-5)
+    d = L2Vertex().apply([a, b])
+    assert d.shape == (2, 1)
+    assert np.allclose(ScaleVertex(scale_factor=2.0).apply([a]), 2 * a)
+    assert np.allclose(ShiftVertex(shift_factor=1.5).apply([a]), a + 1.5)
+    st = StackVertex().apply([a, b])
+    assert st.shape == (4, 3)
+    un = UnstackVertex(from_index=1, stack_size=2).apply([st])
+    assert np.allclose(un, b)
+
+
+def test_last_time_step_vertex_mask(rng):
+    x = jnp.asarray(rng.normal(size=(2, 5, 3)).astype(np.float32))
+    mask = jnp.asarray([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], jnp.float32)
+    out = LastTimeStepVertex().apply([x], mask=mask)
+    assert np.allclose(out[0], x[0, 2])
+    assert np.allclose(out[1], x[1, 4])
+
+
+def test_rnn_to_ff_graph(rng):
+    conf = (_builder()
+            .add_inputs("seq")
+            .add_layer("lstm", GravesLSTM(n_out=6), "seq")
+            .add_vertex("last", LastTimeStepVertex(mask_input="seq"), "lstm")
+            .add_layer("out", OutputLayer(n_out=2, loss="mcxent"), "last")
+            .set_outputs("out")
+            .set_input_types(seq=InputType.recurrent(4, 7))
+            .build())
+    g = ComputationGraph(conf).init()
+    x = rng.normal(size=(8, 7, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+    g.fit([(x, y)] * 2)
+    assert np.asarray(g.output(x)).shape == (8, 2)
+
+
+def test_graph_serde_round_trip(rng):
+    conf = (_builder()
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_out=8), "in")
+            .add_vertex("scaled", ScaleVertex(scale_factor=0.5), "d1")
+            .add_layer("out", OutputLayer(n_out=3, loss="mcxent"), "scaled")
+            .set_outputs("out")
+            .set_input_types(**{"in": InputType.feed_forward(5)})
+            .build())
+    j = conf.to_json()
+    conf2 = ComputationGraphConfiguration.from_json(j)
+    assert conf2.to_json() == j
+    g = ComputationGraph(conf2).init()
+    assert np.asarray(
+        g.output(np.zeros((2, 5), np.float32))).shape == (2, 3)
+
+
+def test_graph_serializer_round_trip(rng, tmp_path):
+    from deeplearning4j_tpu.util import ModelSerializer
+
+    conf = (_builder()
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_out=8), "in")
+            .add_layer("out", OutputLayer(n_out=3, loss="mcxent"), "d1")
+            .set_outputs("out")
+            .set_input_types(**{"in": InputType.feed_forward(5)})
+            .build())
+    g = ComputationGraph(conf).init()
+    x = rng.normal(size=(4, 5)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 4)]
+    g.fit([(x, y)])
+    p = tmp_path / "graph.zip"
+    ModelSerializer.write_model(g, p)
+    g2 = ModelSerializer.restore_computation_graph(p)
+    np.testing.assert_array_equal(np.asarray(g.output(x)),
+                                  np.asarray(g2.output(x)))
+
+
+def test_builder_validation():
+    with pytest.raises(ValueError, match="cycle"):
+        (_builder().add_inputs("in")
+         .add_layer("a", DenseLayer(n_out=4), "b")
+         .add_layer("b", DenseLayer(n_out=4), "a")
+         .add_layer("out", OutputLayer(n_out=2, loss="mcxent"), "b")
+         .set_outputs("out")
+         .set_input_types(**{"in": InputType.feed_forward(3)})
+         .build())
+    with pytest.raises(ValueError, match="duplicate"):
+        (_builder().add_inputs("in")
+         .add_layer("a", DenseLayer(n_out=4), "in")
+         .add_layer("a", DenseLayer(n_out=4), "in")
+         .add_layer("out", OutputLayer(n_out=2, loss="mcxent"), "a")
+         .set_outputs("out")
+         .set_input_types(**{"in": InputType.feed_forward(3)})
+         .build())
+    with pytest.raises(ValueError, match="neither"):
+        (_builder().add_inputs("in")
+         .add_layer("a", DenseLayer(n_out=4), "nonexistent")
+         .add_layer("out", OutputLayer(n_out=2, loss="mcxent"), "a")
+         .set_outputs("out")
+         .set_input_types(**{"in": InputType.feed_forward(3)})
+         .build())
+
+
+def test_graph_gradient_check(rng):
+    with jax.enable_x64(True):
+        conf = (_builder()
+                .add_inputs("in")
+                .add_layer("d1", DenseLayer(n_out=5), "in")
+                .add_layer("d2", DenseLayer(n_out=5), "d1")
+                .add_vertex("skip", ElementWiseVertex(op="add"), "d1", "d2")
+                .add_layer("out", OutputLayer(n_out=3, loss="mcxent"), "skip")
+                .set_outputs("out")
+                .set_input_types(**{"in": InputType.feed_forward(4)})
+                .build())
+        g = ComputationGraph(conf, dtype=jnp.float64).init()
+        x = rng.normal(size=(4, 4))
+        y = np.eye(3)[rng.integers(0, 3, 4)]
+
+        # adapt: graph check via loss wrapper
+        xj = jnp.asarray(x)
+        yj = jnp.asarray(y)
+
+        def loss(params):
+            l, _ = g._loss_fn(params, g.states, {"in": xj}, [yj],
+                              jax.random.PRNGKey(0), None, None, train=True)
+            return l
+
+        analytic = jax.grad(loss)(g.params)
+        flat_p, td = jax.tree_util.tree_flatten(g.params)
+        flat_g = jax.tree_util.tree_leaves(analytic)
+        eps = 1e-6
+        for li in range(len(flat_p)):
+            p = np.array(flat_p[li], np.float64)
+            for i in range(min(p.size, 10)):
+                orig = p.flat[i]
+                p.flat[i] = orig + eps
+                leaves = list(flat_p)
+                leaves[li] = jnp.asarray(p)
+                lp = float(loss(jax.tree_util.tree_unflatten(td, leaves)))
+                p.flat[i] = orig - eps
+                leaves[li] = jnp.asarray(p)
+                lm = float(loss(jax.tree_util.tree_unflatten(td, leaves)))
+                p.flat[i] = orig
+                numeric = (lp - lm) / (2 * eps)
+                a = float(np.asarray(flat_g[li]).flat[i])
+                assert abs(a - numeric) <= 1e-5 * (abs(a) + abs(numeric)) + 1e-8
+
+
+def test_duplicate_to_timeseries(rng):
+    from deeplearning4j_tpu.nn.conf import DuplicateToTimeSeriesVertex
+
+    conf = (_builder()
+            .add_inputs("static", "seq")
+            .add_layer("emb", DenseLayer(n_out=6), "static")
+            .add_vertex("dup", DuplicateToTimeSeriesVertex(ts_input="seq"),
+                        "emb")
+            .add_layer("lstm", GravesLSTM(n_out=5), "dup")
+            .add_layer("out", RnnOutputLayer(n_out=2, loss="mcxent"), "lstm")
+            .set_outputs("out")
+            .set_input_types(static=InputType.feed_forward(4),
+                             seq=InputType.recurrent(3, 6))
+            .build())
+    g = ComputationGraph(conf).init()
+    xs = rng.normal(size=(5, 4)).astype(np.float32)
+    xq = rng.normal(size=(5, 6, 3)).astype(np.float32)
+    y = np.stack([np.eye(2, dtype=np.float32)[rng.integers(0, 2, 6)]
+                  for _ in range(5)])
+    g.fit([([xs, xq], [y])] * 2)
+    assert np.asarray(g.output(xs, xq)).shape == (5, 6, 2)
+
+
+def test_graph_tbptt(rng):
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(9).updater("sgd").learning_rate(0.05)
+            .activation("tanh").weight_init("xavier")
+            .graph_builder()
+            .add_inputs("seq")
+            .add_layer("lstm", GravesLSTM(n_out=5), "seq")
+            .add_layer("out", RnnOutputLayer(n_out=2, loss="mcxent"), "lstm")
+            .set_outputs("out")
+            .set_input_types(seq=InputType.recurrent(3, 12))
+            .build())
+    conf.backprop_type = "truncated_bptt"
+    conf.tbptt_fwd_length = 4
+    g = ComputationGraph(conf).init()
+    x = rng.normal(size=(4, 12, 3)).astype(np.float32)
+    y = np.stack([np.eye(2, dtype=np.float32)[rng.integers(0, 2, 12)]
+                  for _ in range(4)])
+    g.fit([(x, y)] * 2)
+    assert g.iteration == 2 * 3  # 3 chunks per batch
+    assert np.isfinite(g.score())
+
+
+def test_preprocessor_vertex_serde():
+    from deeplearning4j_tpu.nn.conf import PreprocessorVertex
+    from deeplearning4j_tpu.nn.conf.preprocessors import (
+        FeedForwardToRnnPreProcessor,
+    )
+
+    conf = (_builder()
+            .add_inputs("x")
+            .add_layer("d", DenseLayer(n_out=6), "x")
+            .add_vertex("toRnn", PreprocessorVertex(
+                preprocessor=FeedForwardToRnnPreProcessor(1)), "d")
+            .add_layer("out", RnnOutputLayer(n_out=2, loss="mcxent"), "toRnn")
+            .set_outputs("out")
+            .set_input_types(x=InputType.feed_forward(4))
+            .build())
+    conf2 = ComputationGraphConfiguration.from_json(conf.to_json())
+    assert conf2.to_json() == conf.to_json()
+
+
+def test_input_name_collision_rejected():
+    with pytest.raises(ValueError, match="collide"):
+        (_builder().add_inputs("a")
+         .add_layer("a", DenseLayer(n_out=4), "a")
+         .add_layer("out", OutputLayer(n_out=2, loss="mcxent"), "a")
+         .set_outputs("out")
+         .set_input_types(a=InputType.feed_forward(3))
+         .build())
