@@ -1,0 +1,23 @@
+"""Datasets: DataSet containers, iterators (with async prefetch),
+fetchers, and normalizers (parity: deeplearning4j-nn datasets/iterator/*
+and deeplearning4j-core datasets/*)."""
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet  # noqa: F401
+from deeplearning4j_tpu.datasets.iterators import (  # noqa: F401
+    AsyncDataSetIterator,
+    BenchmarkDataSetIterator,
+    DataSetIterator,
+    EarlyTerminationDataSetIterator,
+    ListDataSetIterator,
+    MultipleEpochsIterator,
+)
+from deeplearning4j_tpu.datasets.fetchers import (  # noqa: F401
+    CifarDataSetIterator,
+    IrisDataSetIterator,
+    MnistDataSetIterator,
+)
+from deeplearning4j_tpu.datasets.normalizers import (  # noqa: F401
+    ImagePreProcessingScaler,
+    NormalizerMinMaxScaler,
+    NormalizerStandardize,
+)

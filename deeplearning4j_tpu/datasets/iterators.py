@@ -1,0 +1,234 @@
+"""DataSet iterators (parity: deeplearning4j-nn datasets/iterator/ —
+AsyncDataSetIterator.java:30 background prefetch thread + queue,
+MultipleEpochsIterator.java, EarlyTerminationDataSetIterator.java,
+impl/ListDataSetIterator.java, impl/BenchmarkDataSetIterator.java).
+
+On TPU the iterator's job is to keep the host-side pipeline ahead of the
+device: AsyncDataSetIterator prefetches batches on a daemon thread into a
+bounded queue (the MagicQueue/AsyncPrefetchThread role) so `fit` never
+waits on ETL.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+class DataSetIterator:
+    """Iterator contract: python-iterable + reset() (+ optional
+    total_examples/batch metadata)."""
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    # camelCase compatibility
+    def hasNext(self):
+        return self.has_next()
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Batches over an in-memory list of examples
+    (ref: datasets/iterator/impl/ListDataSetIterator.java)."""
+
+    def __init__(self, data: DataSet, batch_size: int = 32,
+                 shuffle: bool = False, seed: int = 0):
+        self.data = data
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self._epoch = 0
+        self._batches: List[DataSet] = []
+        self._pos = 0
+        self.reset()
+
+    def reset(self):
+        d = self.data
+        if self.shuffle:
+            idx = np.random.default_rng(
+                self.seed + self._epoch).permutation(d.num_examples())
+            d = DataSet(d.features[idx],
+                        None if d.labels is None else d.labels[idx],
+                        None if d.features_mask is None else d.features_mask[idx],
+                        None if d.labels_mask is None else d.labels_mask[idx])
+        self._batches = d.batch_by(self.batch_size)
+        self._pos = 0
+        self._epoch += 1
+
+    def has_next(self):
+        return self._pos < len(self._batches)
+
+    def __next__(self):
+        if not self.has_next():
+            raise StopIteration
+        b = self._batches[self._pos]
+        self._pos += 1
+        return b
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch wrapper
+    (ref: AsyncDataSetIterator.java:30,36 AsyncPrefetchThread)."""
+
+    _SENTINEL = object()
+
+    def __init__(self, base: Iterable, queue_size: int = 4):
+        self.base = base
+        self.queue_size = queue_size
+        self._q: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._gen = 0  # restart generation: stale producers self-terminate
+
+    def _start(self):
+        self._gen += 1
+        gen = self._gen
+        q = queue.Queue(maxsize=self.queue_size)
+        self._q = q
+        self._error = None
+
+        def producer():
+            # capture q/gen locally: after a reset() the old thread must
+            # never feed (or sentinel-terminate) the new queue
+            def put(item) -> bool:
+                while self._gen == gen:
+                    try:
+                        q.put(item, timeout=0.05)
+                        return True
+                    except queue.Full:
+                        continue
+                return False  # superseded by a restart
+
+            try:
+                for item in self.base:
+                    if not put(item):
+                        return
+            except BaseException as e:  # surfaced on the consumer side
+                if self._gen == gen:
+                    self._error = e
+            put(self._SENTINEL)
+
+        self._thread = threading.Thread(target=producer, daemon=True,
+                                        name="AsyncDataSetIterator-prefetch")
+        self._thread.start()
+
+    def __iter__(self):
+        if hasattr(self.base, "reset"):
+            self.base.reset()
+        self._start()
+        return self
+
+    def reset(self):
+        if hasattr(self.base, "reset"):
+            self.base.reset()
+        self._start()
+
+    def __next__(self):
+        if self._q is None:
+            self._start()
+        item = self._q.get()
+        if item is self._SENTINEL:
+            self._q = None
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        return item
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Replays a base iterator N times (ref: MultipleEpochsIterator.java)."""
+
+    def __init__(self, epochs: int, base):
+        self.epochs = epochs
+        self.base = base
+        self._epoch = 0
+        self._inner = None
+
+    def reset(self):
+        self._epoch = 0
+        self._inner = None
+        if hasattr(self.base, "reset"):
+            self.base.reset()
+
+    def __next__(self):
+        if self._inner is None:
+            self._inner = iter(self.base)
+        while True:
+            try:
+                return next(self._inner)
+            except StopIteration:
+                self._epoch += 1
+                if self._epoch >= self.epochs:
+                    raise
+                if hasattr(self.base, "reset"):
+                    self.base.reset()
+                self._inner = iter(self.base)
+
+
+class EarlyTerminationDataSetIterator(DataSetIterator):
+    """Caps the number of batches (ref: EarlyTerminationDataSetIterator.java)."""
+
+    def __init__(self, base, max_batches: int):
+        self.base = base
+        self.max_batches = max_batches
+        self._count = 0
+        self._inner = None
+
+    def reset(self):
+        self._count = 0
+        self._inner = None
+        if hasattr(self.base, "reset"):
+            self.base.reset()
+
+    def __next__(self):
+        if self._count >= self.max_batches:
+            raise StopIteration
+        if self._inner is None:
+            self._inner = iter(self.base)
+        self._count += 1
+        return next(self._inner)
+
+
+class BenchmarkDataSetIterator(DataSetIterator):
+    """Yields the same synthetic batch N times — zero-ETL throughput
+    harness (ref: impl/BenchmarkDataSetIterator.java)."""
+
+    def __init__(self, feature_shape, num_classes: int, num_batches: int,
+                 seed: int = 0, label_shape=None):
+        rng = np.random.default_rng(seed)
+        x = rng.normal(size=feature_shape).astype(np.float32)
+        if label_shape is not None:
+            y = rng.normal(size=label_shape).astype(np.float32)
+        else:
+            y = np.eye(num_classes, dtype=np.float32)[
+                rng.integers(0, num_classes, feature_shape[0])]
+        self.batch = DataSet(x, y)
+        self.num_batches = num_batches
+        self._pos = 0
+
+    def reset(self):
+        self._pos = 0
+
+    def has_next(self):
+        return self._pos < self.num_batches
+
+    def __next__(self):
+        if not self.has_next():
+            raise StopIteration
+        self._pos += 1
+        return self.batch

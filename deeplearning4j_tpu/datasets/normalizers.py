@@ -1,0 +1,114 @@
+"""Data normalizers (parity: ND4J NormalizerStandardize /
+NormalizerMinMaxScaler / ImagePreProcessingScaler, persisted as
+normalizer.bin in ModelSerializer zips — util/ModelSerializer.java:40-41)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+_REGISTRY = {}
+
+
+def register(cls):
+    _REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def normalizer_from_dict(d: dict):
+    d = dict(d)
+    kind = d.pop("type")
+    if kind not in _REGISTRY:
+        raise ValueError(f"Unknown normalizer '{kind}'; known {sorted(_REGISTRY)}")
+    n = _REGISTRY[kind]()
+    n.__dict__.update({k: (np.asarray(v) if isinstance(v, list) else v)
+                       for k, v in d.items()})
+    return n
+
+
+class Normalizer:
+    def fit(self, dataset_or_iterator):
+        raise NotImplementedError
+
+    def transform(self, dataset):
+        raise NotImplementedError
+
+    def pre_process(self, dataset):
+        return self.transform(dataset)
+
+    def to_dict(self) -> dict:
+        d = {"type": type(self).__name__}
+        for k, v in self.__dict__.items():
+            d[k] = v.tolist() if isinstance(v, np.ndarray) else v
+        return d
+
+    def _iter_features(self, it):
+        if hasattr(it, "features"):
+            yield np.asarray(it.features)
+            return
+        for b in it:
+            yield np.asarray(b.features if hasattr(b, "features") else b[0])
+
+
+@register
+class NormalizerStandardize(Normalizer):
+    """Zero-mean unit-variance per feature."""
+
+    def __init__(self):
+        self.mean = None
+        self.std = None
+
+    def fit(self, data):
+        feats = np.concatenate(list(self._iter_features(data)), axis=0)
+        axes = tuple(range(feats.ndim - 1))
+        self.mean = feats.mean(axis=axes)
+        self.std = feats.std(axis=axes) + 1e-8
+        return self
+
+    def transform(self, ds):
+        ds.features = (ds.features - self.mean) / self.std
+        return ds
+
+    def revert_features(self, x):
+        return x * self.std + self.mean
+
+
+@register
+class NormalizerMinMaxScaler(Normalizer):
+    """Scale features into [min_range, max_range]."""
+
+    def __init__(self, min_range: float = 0.0, max_range: float = 1.0):
+        self.min_range = min_range
+        self.max_range = max_range
+        self.data_min = None
+        self.data_max = None
+
+    def fit(self, data):
+        feats = np.concatenate(list(self._iter_features(data)), axis=0)
+        axes = tuple(range(feats.ndim - 1))
+        self.data_min = feats.min(axis=axes)
+        self.data_max = feats.max(axis=axes)
+        return self
+
+    def transform(self, ds):
+        span = np.maximum(self.data_max - self.data_min, 1e-8)
+        scaled = (ds.features - self.data_min) / span
+        ds.features = scaled * (self.max_range - self.min_range) + self.min_range
+        return ds
+
+
+@register
+class ImagePreProcessingScaler(Normalizer):
+    """Pixel scale [0, max_pixel] -> [a, b] (default [0,1]); stateless."""
+
+    def __init__(self, a: float = 0.0, b: float = 1.0,
+                 max_pixel: float = 255.0):
+        self.a = a
+        self.b = b
+        self.max_pixel = max_pixel
+
+    def fit(self, data):
+        return self
+
+    def transform(self, ds):
+        ds.features = (ds.features / self.max_pixel) * (self.b - self.a) + self.a
+        return ds

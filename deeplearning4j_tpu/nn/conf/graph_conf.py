@@ -19,7 +19,7 @@ from deeplearning4j_tpu.nn.conf.graph_vertices import (
     vertex_from_dict,
 )
 from deeplearning4j_tpu.nn.conf.inputs import InputType
-from deeplearning4j_tpu.nn.conf.network import BackpropType, _INHERITED
+from deeplearning4j_tpu.nn.conf.network import BackpropType
 from deeplearning4j_tpu.nn.conf.preprocessors import (
     InputPreProcessor,
     infer_preprocessor,
@@ -160,6 +160,46 @@ class ComputationGraphConfiguration:
             return types, layer_inputs
         return types
 
+    def validate(self) -> "ComputationGraphConfiguration":
+        """Eagerly validate registry-resolved names so typos fail at build
+        time (same contract as MultiLayerConfiguration.validate)."""
+        from deeplearning4j_tpu.nn.activations import get_activation
+        from deeplearning4j_tpu.nn.losses import get_loss
+        from deeplearning4j_tpu.nn.updater import get_updater
+        from deeplearning4j_tpu.nn.weights import WEIGHT_INITS
+
+        get_updater(self.updater, self)
+        _valid_gn = {
+            "none", "renormalize_l2_per_layer",
+            "renormalize_l2_per_param_type",
+            "clip_element_wise_absolute_value", "clip_l2_per_layer",
+            "clip_l2_per_param_type",
+        }
+        if self.gradient_normalization and \
+                self.gradient_normalization not in _valid_gn:
+            raise ValueError(
+                f"Unknown gradient_normalization "
+                f"'{self.gradient_normalization}'. Known: {sorted(_valid_gn)}")
+        for node in self.nodes:
+            if node.kind != "layer":
+                continue
+            layer = node.obj
+            act = getattr(layer, "activation", None)
+            if act is not None:
+                get_activation(act)
+            wi = getattr(layer, "weight_init", None)
+            if wi is not None and not callable(wi) \
+                    and str(wi).lower() not in WEIGHT_INITS:
+                raise ValueError(
+                    f"Node '{node.name}': unknown weight init '{wi}'. "
+                    f"Known: {sorted(WEIGHT_INITS)}")
+            loss = getattr(layer, "loss", None)
+            if loss is not None:
+                get_loss(loss)
+            if layer.updater is not None:
+                get_updater(layer.updater, self)
+        return self
+
     # ----------------------------------------------------------------- serde
     def to_dict(self):
         d = {}
@@ -277,7 +317,16 @@ class GraphBuilder:
         return self
 
     def build(self) -> ComputationGraphConfiguration:
+        import copy
+
         conf = self._conf
+        # deepcopy node objects so build() never mutates caller-owned
+        # layers (ListBuilder.build has the same contract)
+        conf.nodes = [GraphNode(
+            name=n.name, kind=n.kind, obj=copy.deepcopy(n.obj),
+            inputs=list(n.inputs),
+            preprocessor=copy.deepcopy(n.preprocessor))
+            for n in conf.nodes]
         if not conf.network_inputs:
             raise ValueError("graph has no inputs (add_inputs)")
         if not conf.network_outputs:
@@ -319,4 +368,4 @@ class GraphBuilder:
             conf.resolve_shapes()
         else:
             conf.topological_order()
-        return conf
+        return conf.validate()

@@ -192,10 +192,13 @@ class ComputationGraph:
             if node.preprocessor is not None:
                 x = node.preprocessor.preprocess(x)
             layer = node.obj
+            if rng is not None:
+                x = layer._maybe_dropout_input(
+                    x, train, jax.random.fold_in(rng, 0x0D0 + oi))
             y = labels[oi]
             lm = None if label_masks is None else label_masks[oi]
-            pre = layer.pre_output(params[node.name], x)
-            per_ex = layer.compute_per_example_loss(y, pre, mask=lm)
+            per_ex = layer.per_example_loss_from_input(
+                params[node.name], x, y, mask=lm)
             if lm is not None:
                 active = lm if lm.ndim == 1 else jnp.any(lm > 0, axis=1)
                 s = jnp.sum(per_ex)

@@ -4,6 +4,7 @@ from deeplearning4j_tpu.nn.layers.core import (  # noqa: F401
     ActivationLayer,
     DropoutLayer,
     EmbeddingLayer,
+    CenterLossOutputLayer,
     OutputLayer,
     RnnOutputLayer,
     LossLayer,

@@ -90,7 +90,7 @@ class Layer:
         for path, leaf in leaves_with_path:
             last = path[-1]
             key_name = getattr(last, "key", None) or getattr(last, "name", "")
-            if str(key_name).startswith("b"):
+            if str(key_name).startswith("b") or str(key_name) == "centers":
                 continue
             if l2:
                 reg = reg + 0.5 * l2 * jnp.sum(leaf * leaf)

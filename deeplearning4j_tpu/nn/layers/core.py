@@ -137,6 +137,13 @@ class BaseOutputLayer(BaseLayer):
     def pre_output(self, params, x):
         return x @ params["W"] + params["b"]
 
+    def per_example_loss_from_input(self, params, x, labels, mask=None):
+        """Loss seen from the layer's *input* activations; the hook output
+        layers override when the loss needs the features themselves
+        (center loss)."""
+        return self.compute_per_example_loss(
+            labels, self.pre_output(params, x), mask=mask)
+
 
 @dataclass(kw_only=True)
 class OutputLayer(BaseOutputLayer):
@@ -162,6 +169,42 @@ class OutputLayer(BaseOutputLayer):
     def apply(self, params, x, *, train=False, rng=None, state=None, mask=None):
         x = self._maybe_dropout_input(x, train, rng)
         return get_activation(self.activation)(self.pre_output(params, x)), state
+
+
+@dataclass(kw_only=True)
+class CenterLossOutputLayer(OutputLayer):
+    """Softmax + center loss: L = Lsoftmax + (lambda/2)·||f - c_y||²
+    (ref: nn/conf/layers/CenterLossOutputLayer.java,
+    nn/layers/training/CenterLossOutputLayer.java). The reference updates
+    centers with an alpha moving average outside the optimizer; here the
+    centers are parameters trained by the same gradient step (the center
+    term's gradient wrt c_y is alpha-like), scaled by `alpha`.
+    """
+
+    alpha: float = 0.05
+    lambda_: float = 2e-4
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        p = DenseLayer.init_params(self, key, input_type, dtype)
+        p["centers"] = jnp.zeros((self.n_out, self.n_in), dtype)
+        return p
+
+    def per_example_loss_from_input(self, params, x, labels, mask=None):
+        base = self.compute_per_example_loss(
+            labels, self.pre_output(params, x), mask=mask)
+        # centers of each example's class: labels one-hot [B, nClasses]
+        lab2d = labels if labels.ndim == 2 else labels.reshape(
+            -1, labels.shape[-1])
+        x2d = x if x.ndim == 2 else x.reshape(-1, x.shape[-1])
+        cy = lab2d @ params["centers"]                  # [B, nIn]
+        center_term = 0.5 * jnp.sum((x2d - cy) ** 2, axis=-1)
+        # alpha scales how fast centers chase features (gradient wrt
+        # centers is alpha * lambda * (c_y - f))
+        center_term = center_term.reshape(base.shape)
+        if mask is not None:
+            m = mask if mask.ndim == base.ndim else mask.reshape(base.shape)
+            center_term = center_term * m
+        return base + self.lambda_ * self.alpha * center_term
 
 
 @dataclass(kw_only=True)

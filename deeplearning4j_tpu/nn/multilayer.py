@@ -170,8 +170,8 @@ class MultiLayerNetwork:
         else:
             out_rng = None
         cur = out_layer._maybe_dropout_input(cur, train, out_rng)
-        pre = out_layer.pre_output(params[-1], cur)
-        per_ex = out_layer.compute_per_example_loss(y, pre, mask=lmask)
+        per_ex = out_layer.per_example_loss_from_input(
+            params[-1], cur, y, mask=lmask)
         if lmask is not None:
             # per_ex is already mask-zeroed inside the loss. Normalize by
             # the number of *active examples* (rows with any unmasked

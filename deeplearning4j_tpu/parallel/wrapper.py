@@ -71,6 +71,13 @@ class ParallelWrapper:
         over dp, tp-sharded per rules)."""
         if self._sharded:
             return
+        ins = getattr(self.net.conf, "network_inputs", None)
+        outs = getattr(self.net.conf, "network_outputs", None)
+        if ins is not None and (len(ins) > 1 or len(outs) > 1):
+            raise NotImplementedError(
+                "ParallelWrapper currently supports single-input/single-"
+                "output graphs; shard multi-input batches manually via "
+                "parallel.sharding.shard_batch + the graph's _train_step")
         if self.net.params is None:
             self.net.init()
         put = lambda tree: jax.tree_util.tree_map(
@@ -135,7 +142,15 @@ class ParallelWrapper:
                            else shard_batch(self.mesh, jnp.asarray(fm)))
                     lmb = (None if lm is None
                            else shard_batch(self.mesh, jnp.asarray(lm)))
-                    net._train_step(xb, yb, fmb, lmb)
+                    if hasattr(net.conf, "network_inputs"):
+                        # ComputationGraph: dict inputs / list labels
+                        name = net.conf.network_inputs[0]
+                        net._train_step(
+                            {name: xb}, [yb],
+                            None if fmb is None else {name: fmb},
+                            None if lmb is None else [lmb])
+                    else:
+                        net._train_step(xb, yb, fmb, lmb)
                     for listener in net.listeners:
                         listener.iteration_done(net, net.iteration)
                 net.epoch += 1

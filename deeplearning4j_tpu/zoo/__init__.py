@@ -1,0 +1,21 @@
+"""Model zoo: the 10 instantiable reference architectures plus base/selector
+(parity: deeplearning4j-zoo/.../zoo/model/ — AlexNet, FaceNetNN4Small2,
+GoogLeNet, InceptionResNetV1, LeNet, ResNet50, SimpleCNN,
+TextGenerationLSTM, VGG16, VGG19; ZooModel.java:40-81, ModelSelector.java).
+
+All conv models are NHWC + bfloat16-friendly (MXU-aligned channel counts
+where the original architecture allows)."""
+
+from deeplearning4j_tpu.zoo.base import ZooModel, ModelSelector, ZooType  # noqa: F401
+from deeplearning4j_tpu.zoo.models import (  # noqa: F401
+    AlexNet,
+    FaceNetNN4Small2,
+    GoogLeNet,
+    InceptionResNetV1,
+    LeNet,
+    ResNet50,
+    SimpleCNN,
+    TextGenerationLSTM,
+    VGG16,
+    VGG19,
+)

@@ -1,0 +1,120 @@
+"""ZooModel base + ModelSelector (ref: zoo/ZooModel.java:40-81,
+zoo/ModelSelector.java).
+
+The reference downloads pretrained weights over HTTP with checksum
+validation (ZooModel.java:81). This build has no egress in CI; pretrained
+loading is file-based (`load_pretrained(path)` on a ModelSerializer zip or
+Keras HDF5 via deeplearning4j_tpu.modelimport)."""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence, Type
+
+
+class ZooType:
+    ALEXNET = "alexnet"
+    FACENETNN4SMALL2 = "facenetnn4small2"
+    GOOGLENET = "googlenet"
+    INCEPTIONRESNETV1 = "inceptionresnetv1"
+    LENET = "lenet"
+    RESNET50 = "resnet50"
+    SIMPLECNN = "simplecnn"
+    TEXTGENLSTM = "textgenlstm"
+    VGG16 = "vgg16"
+    VGG19 = "vgg19"
+    ALL = "all"
+    CNN = "cnn"
+    RNN = "rnn"
+
+
+class ZooModel:
+    """Base class: subclasses implement conf() -> configuration and
+    init_model() -> initialized network."""
+
+    num_classes: int = 1000
+    input_shape: Sequence[int] = (224, 224, 3)
+
+    def __init__(self, num_classes: Optional[int] = None,
+                 input_shape: Optional[Sequence[int]] = None,
+                 seed: int = 123, updater: str = "nesterovs",
+                 learning_rate: float = 1e-2):
+        if num_classes is not None:
+            self.num_classes = num_classes
+        if input_shape is not None:
+            self.input_shape = tuple(input_shape)
+        self.seed = seed
+        self.updater = updater
+        self.learning_rate = learning_rate
+
+    def conf(self):
+        raise NotImplementedError
+
+    def init_model(self):
+        from deeplearning4j_tpu.nn.conf.graph_conf import (
+            ComputationGraphConfiguration,
+        )
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        c = self.conf()
+        if isinstance(c, ComputationGraphConfiguration):
+            return ComputationGraph(c).init()
+        return MultiLayerNetwork(c).init()
+
+    # -------- pretrained (file-based; no egress) --------
+    def pretrained_available(self) -> bool:
+        return self.pretrained_path() is not None
+
+    def pretrained_path(self) -> Optional[str]:
+        root = os.environ.get("DL4J_TPU_PRETRAINED_DIR",
+                              os.path.expanduser("~/.deeplearning4j_tpu"))
+        p = os.path.join(root, f"{type(self).__name__.lower()}.zip")
+        return p if os.path.exists(p) else None
+
+    def load_pretrained(self, path: Optional[str] = None):
+        from deeplearning4j_tpu.util.model_guesser import ModelGuesser
+
+        path = path or self.pretrained_path()
+        if path is None:
+            raise FileNotFoundError(
+                f"No pretrained weights for {type(self).__name__}; place a "
+                "model zip under $DL4J_TPU_PRETRAINED_DIR")
+        return ModelGuesser.load_model_guess(path)
+
+
+class ModelSelector:
+    """Select zoo models by type (ref: zoo/ModelSelector.java)."""
+
+    @staticmethod
+    def registry() -> Dict[str, Type[ZooModel]]:
+        from deeplearning4j_tpu.zoo import models as m
+
+        return {
+            ZooType.ALEXNET: m.AlexNet,
+            ZooType.FACENETNN4SMALL2: m.FaceNetNN4Small2,
+            ZooType.GOOGLENET: m.GoogLeNet,
+            ZooType.INCEPTIONRESNETV1: m.InceptionResNetV1,
+            ZooType.LENET: m.LeNet,
+            ZooType.RESNET50: m.ResNet50,
+            ZooType.SIMPLECNN: m.SimpleCNN,
+            ZooType.TEXTGENLSTM: m.TextGenerationLSTM,
+            ZooType.VGG16: m.VGG16,
+            ZooType.VGG19: m.VGG19,
+        }
+
+    @staticmethod
+    def select(zoo_type: str, **kwargs) -> Dict[str, ZooModel]:
+        reg = ModelSelector.registry()
+        if zoo_type == ZooType.ALL:
+            names = list(reg)
+        elif zoo_type == ZooType.CNN:
+            names = [n for n in reg if n != ZooType.TEXTGENLSTM]
+        elif zoo_type == ZooType.RNN:
+            names = [ZooType.TEXTGENLSTM]
+        elif zoo_type in reg:
+            names = [zoo_type]
+        else:
+            raise ValueError(
+                f"Unknown zoo type '{zoo_type}'; known: {sorted(reg)}")
+        return {n: reg[n](**kwargs) for n in names}

@@ -1,0 +1,152 @@
+"""Dataset container/iterator/normalizer/fetcher tests (ref:
+deeplearning4j-core datasets tests + AsyncDataSetIterator tests)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import (
+    AsyncDataSetIterator,
+    BenchmarkDataSetIterator,
+    CifarDataSetIterator,
+    DataSet,
+    EarlyTerminationDataSetIterator,
+    ImagePreProcessingScaler,
+    IrisDataSetIterator,
+    ListDataSetIterator,
+    MnistDataSetIterator,
+    MultipleEpochsIterator,
+    NormalizerMinMaxScaler,
+    NormalizerStandardize,
+)
+
+
+def _ds(rng, n=50, d=4, c=3):
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = np.eye(c, dtype=np.float32)[rng.integers(0, c, n)]
+    return DataSet(x, y)
+
+
+def test_list_iterator_batches(rng):
+    it = ListDataSetIterator(_ds(rng), batch_size=16)
+    batches = list(it)
+    assert [b.num_examples() for b in batches] == [16, 16, 16, 2]
+    # reset replays
+    assert len(list(it)) == 4
+
+
+def test_list_iterator_shuffles_per_epoch(rng):
+    it = ListDataSetIterator(_ds(rng), batch_size=50, shuffle=True)
+    b1 = next(iter(it)).features.copy()
+    b2 = next(iter(it)).features.copy()
+    assert not np.array_equal(b1, b2)
+    assert np.array_equal(np.sort(b1, axis=0), np.sort(b2, axis=0))
+
+
+def test_async_iterator_matches_sync(rng):
+    ds = _ds(rng)
+    base = ListDataSetIterator(ds, batch_size=8)
+    sync = [b.features.copy() for b in base]
+    async_it = AsyncDataSetIterator(ListDataSetIterator(ds, batch_size=8))
+    got = [b.features.copy() for b in async_it]
+    assert len(got) == len(sync)
+    for a, b in zip(got, sync):
+        np.testing.assert_array_equal(a, b)
+    # second pass works (reset + restart thread)
+    assert len(list(async_it)) == len(sync)
+
+
+def test_async_iterator_propagates_errors():
+    def boom():
+        yield DataSet(np.zeros((2, 2)), np.zeros((2, 2)))
+        raise RuntimeError("producer failed")
+
+    it = AsyncDataSetIterator(boom())
+    next(iter(it))
+    with pytest.raises(RuntimeError, match="producer failed"):
+        next(it)
+
+
+def test_multiple_epochs_and_early_termination(rng):
+    base = ListDataSetIterator(_ds(rng, n=32), batch_size=16)
+    me = MultipleEpochsIterator(3, base)
+    assert len(list(me)) == 6
+    et = EarlyTerminationDataSetIterator(
+        ListDataSetIterator(_ds(rng, n=32), batch_size=8), max_batches=2)
+    assert len(list(et)) == 2
+
+
+def test_benchmark_iterator():
+    it = BenchmarkDataSetIterator((16, 8), 4, num_batches=5)
+    bs = list(it)
+    assert len(bs) == 5 and bs[0].features.shape == (16, 8)
+
+
+def test_normalizer_standardize(rng):
+    ds = _ds(rng, n=200)
+    norm = NormalizerStandardize().fit(ds)
+    out = norm.transform(DataSet(ds.features.copy(), ds.labels))
+    assert np.allclose(out.features.mean(axis=0), 0, atol=1e-5)
+    assert np.allclose(out.features.std(axis=0), 1, atol=1e-4)
+    # serde round trip
+    from deeplearning4j_tpu.datasets.normalizers import normalizer_from_dict
+    norm2 = normalizer_from_dict(norm.to_dict())
+    out2 = norm2.transform(DataSet(ds.features.copy(), ds.labels))
+    np.testing.assert_allclose(out.features, out2.features, rtol=1e-6)
+
+
+def test_normalizer_minmax(rng):
+    ds = _ds(rng, n=100)
+    norm = NormalizerMinMaxScaler(0.0, 1.0).fit(ds)
+    out = norm.transform(ds)
+    assert out.features.min() >= -1e-6 and out.features.max() <= 1 + 1e-6
+
+
+def test_image_scaler():
+    ds = DataSet(np.full((2, 4, 4, 1), 255.0), np.zeros((2, 2)))
+    out = ImagePreProcessingScaler().transform(ds)
+    assert np.allclose(out.features, 1.0)
+
+
+def test_iris_iterator():
+    it = IrisDataSetIterator(batch_size=50)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].features.shape == (50, 4)
+    assert batches[0].labels.shape == (50, 3)
+    # canonical first row
+    np.testing.assert_allclose(batches[0].features[0],
+                               [5.1, 3.5, 1.4, 0.2], atol=1e-6)
+
+
+def test_mnist_iterator_shapes():
+    it = MnistDataSetIterator(batch_size=64, train=True,
+                              num_examples=256)
+    b = next(iter(it))
+    assert b.features.shape == (64, 28, 28, 1)
+    assert b.labels.shape == (64, 10)
+    assert 0.0 <= b.features.min() and b.features.max() <= 1.0
+
+
+def test_cifar_iterator_shapes():
+    it = CifarDataSetIterator(batch_size=32, num_examples=64)
+    b = next(iter(it))
+    assert b.features.shape == (32, 32, 32, 3)
+    assert b.labels.shape == (32, 10)
+
+
+def test_mnist_end_to_end_training():
+    """The PR1 slice (SURVEY §7 step 3): LeNet on (possibly synthetic)
+    MNIST reaches high accuracy and round-trips through the serializer."""
+    from deeplearning4j_tpu.eval import Evaluation
+    from deeplearning4j_tpu.zoo import LeNet
+
+    train = MnistDataSetIterator(batch_size=128, train=True,
+                                 num_examples=2048)
+    test = MnistDataSetIterator(batch_size=256, train=False, shuffle=False,
+                                num_examples=512)
+    net = LeNet(updater="adam", learning_rate=1e-3).init_model()
+    net.fit(AsyncDataSetIterator(train), epochs=3)
+    ev = Evaluation(10)
+    for b in test:
+        ev.eval(b.labels, np.asarray(net.output(b.features)))
+    assert ev.accuracy() > 0.9, ev.stats()

@@ -1,0 +1,110 @@
+"""Zoo model construction + forward-shape tests (ref: deeplearning4j-zoo
+tests instantiate each model and run a forward pass)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.zoo import (
+    AlexNet,
+    FaceNetNN4Small2,
+    GoogLeNet,
+    InceptionResNetV1,
+    LeNet,
+    ModelSelector,
+    ResNet50,
+    SimpleCNN,
+    TextGenerationLSTM,
+    VGG16,
+    VGG19,
+    ZooType,
+)
+
+
+def test_lenet_trains(rng):
+    net = LeNet(num_classes=5, updater="adam", learning_rate=1e-3).init_model()
+    x = rng.normal(size=(8, 28, 28, 1)).astype(np.float32)
+    y = np.eye(5, dtype=np.float32)[rng.integers(0, 5, 8)]
+    net.fit([(x, y)] * 2)
+    assert np.asarray(net.output(x)).shape == (8, 5)
+
+
+def test_simple_cnn_forward(rng):
+    net = SimpleCNN(num_classes=4, input_shape=(32, 32, 3)).init_model()
+    x = rng.normal(size=(2, 32, 32, 3)).astype(np.float32)
+    assert np.asarray(net.output(x)).shape == (2, 4)
+
+
+def test_alexnet_shapes(rng):
+    net = AlexNet(num_classes=10, input_shape=(64, 64, 3)).init_model()
+    x = rng.normal(size=(2, 64, 64, 3)).astype(np.float32)
+    assert np.asarray(net.output(x)).shape == (2, 10)
+
+
+@pytest.mark.parametrize("cls,blocks", [(VGG16, 13), (VGG19, 16)])
+def test_vgg_conv_counts(cls, blocks):
+    model = cls(num_classes=7, input_shape=(32, 32, 3))
+    conf = model.conf()
+    from deeplearning4j_tpu.nn.layers import ConvolutionLayer
+    n_convs = sum(isinstance(l, ConvolutionLayer) for l in conf.layers)
+    assert n_convs == blocks
+    net = model.init_model()
+    assert net.num_params() > 1e6
+
+
+def test_resnet50_structure(rng):
+    model = ResNet50(num_classes=11, input_shape=(64, 64, 3))
+    net = model.init_model()
+    # 53 conv layers in ResNet-50 (49 main-path + 4 shortcut projections = 53)
+    from deeplearning4j_tpu.nn.layers import ConvolutionLayer
+    convs = [n for n in net.topo
+             if n.kind == "layer" and isinstance(n.obj, ConvolutionLayer)]
+    assert len(convs) == 53
+    x = rng.normal(size=(2, 64, 64, 3)).astype(np.float32)
+    assert np.asarray(net.output(x)).shape == (2, 11)
+
+
+def test_googlenet_builds(rng):
+    net = GoogLeNet(num_classes=6, input_shape=(64, 64, 3)).init_model()
+    x = rng.normal(size=(2, 64, 64, 3)).astype(np.float32)
+    assert np.asarray(net.output(x)).shape == (2, 6)
+
+
+def test_inception_resnet_v1_builds(rng):
+    net = InceptionResNetV1(num_classes=5,
+                            input_shape=(64, 64, 3)).init_model()
+    x = rng.normal(size=(2, 64, 64, 3)).astype(np.float32)
+    out = np.asarray(net.output(x))
+    assert out.shape == (2, 5)
+    # embeddings are L2-normalized
+    emb = np.asarray(net.feed_forward(x)["embeddings"])
+    np.testing.assert_allclose(np.linalg.norm(emb, axis=1), 1.0, atol=1e-3)
+
+
+def test_facenet_trains_center_loss(rng):
+    net = FaceNetNN4Small2(num_classes=4, input_shape=(32, 32, 3),
+                           updater="adam", learning_rate=1e-3).init_model()
+    x = rng.normal(size=(4, 32, 32, 3)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 4)]
+    net.fit([(x, y)])
+    assert np.isfinite(net.score())
+
+
+def test_text_generation_lstm(rng):
+    model = TextGenerationLSTM(num_classes=20, input_shape=(30, 20),
+                               learning_rate=1e-2)
+    net = model.init_model()
+    x = rng.normal(size=(2, 30, 20)).astype(np.float32)
+    y = np.stack([np.eye(20, dtype=np.float32)[rng.integers(0, 20, 30)]
+                  for _ in range(2)])
+    net.fit([(x, y)])
+    assert np.asarray(net.output(x)).shape == (2, 30, 20)
+
+
+def test_model_selector():
+    sel = ModelSelector.select(ZooType.CNN, num_classes=3,
+                               input_shape=(32, 32, 3))
+    assert len(sel) == 9 and "lenet" in sel
+    sel = ModelSelector.select(ZooType.RNN)
+    assert list(sel) == ["textgenlstm"]
+    with pytest.raises(ValueError):
+        ModelSelector.select("nope")
