@@ -1,0 +1,72 @@
+"""Model savers (parity: earlystopping/saver/InMemoryModelSaver.java,
+LocalFileModelSaver.java, LocalFileGraphSaver.java)."""
+
+from __future__ import annotations
+
+import copy
+import os
+
+
+class InMemoryModelSaver:
+    def __init__(self):
+        self._best = None
+        self._latest = None
+
+    def save_best_model(self, net, score):
+        self._best = (copy.deepcopy(net.params),
+                      copy.deepcopy(net.states), score)
+
+    def save_latest_model(self, net, score):
+        self._latest = (copy.deepcopy(net.params),
+                        copy.deepcopy(net.states), score)
+
+    def get_best_model(self, like_net=None):
+        if self._best is None:
+            return None
+        if like_net is not None:
+            like_net.params, like_net.states = (copy.deepcopy(self._best[0]),
+                                                copy.deepcopy(self._best[1]))
+            return like_net
+        return self._best
+
+    def get_latest_model(self, like_net=None):
+        if self._latest is None:
+            return None
+        if like_net is not None:
+            like_net.params, like_net.states = (copy.deepcopy(self._latest[0]),
+                                                copy.deepcopy(self._latest[1]))
+            return like_net
+        return self._latest
+
+
+class LocalFileModelSaver:
+    """Zip-based best/latest checkpoints in a directory."""
+
+    def __init__(self, directory):
+        self.directory = str(directory)
+        os.makedirs(self.directory, exist_ok=True)
+
+    def _path(self, tag):
+        return os.path.join(self.directory, f"{tag}Model.zip")
+
+    def save_best_model(self, net, score):
+        from deeplearning4j_tpu.util.model_serializer import write_model
+        write_model(net, self._path("best"))
+
+    def save_latest_model(self, net, score):
+        from deeplearning4j_tpu.util.model_serializer import write_model
+        write_model(net, self._path("latest"))
+
+    def get_best_model(self, like_net=None):
+        from deeplearning4j_tpu.util.model_guesser import ModelGuesser
+        p = self._path("best")
+        return ModelGuesser.load_model_guess(p) if os.path.exists(p) else None
+
+    def get_latest_model(self, like_net=None):
+        from deeplearning4j_tpu.util.model_guesser import ModelGuesser
+        p = self._path("latest")
+        return ModelGuesser.load_model_guess(p) if os.path.exists(p) else None
+
+
+# graph models serialize identically
+LocalFileGraphSaver = LocalFileModelSaver

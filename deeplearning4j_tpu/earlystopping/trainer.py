@@ -1,0 +1,86 @@
+"""EarlyStoppingTrainer (parity: earlystopping/trainer/
+EarlyStoppingTrainer.java / BaseEarlyStoppingTrainer.java): epoch loop
+with per-iteration abort conditions, per-epoch held-out scoring, best-
+model checkpointing."""
+
+from __future__ import annotations
+
+import logging
+
+from deeplearning4j_tpu.earlystopping.config import (
+    EarlyStoppingResult,
+    TerminationReason,
+)
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class EarlyStoppingTrainer:
+    def __init__(self, config, net, train_iterator):
+        self.config = config
+        self.net = net
+        self.train_iterator = train_iterator
+
+    def fit(self) -> EarlyStoppingResult:
+        cfg = self.config
+        net = self.net
+        if net.params is None:
+            net.init()
+        for c in (cfg.epoch_termination_conditions
+                  + cfg.iteration_termination_conditions):
+            c.initialize()
+        score_vs_epoch = {}
+        best_score = None
+        best_epoch = -1
+        epoch = 0
+        reason = None
+        details = ""
+
+        while reason is None:
+            if hasattr(self.train_iterator, "reset"):
+                self.train_iterator.reset()
+            for batch in self.train_iterator:
+                net.fit(batch if not isinstance(batch, tuple) else batch)
+                score = net.score()
+                for c in cfg.iteration_termination_conditions:
+                    if c.terminate(score):
+                        reason = TerminationReason.ITERATION_TERMINATION
+                        details = f"{type(c).__name__} at score {score}"
+                        break
+                if reason:
+                    break
+            if reason:
+                break
+
+            if epoch % cfg.evaluate_every_n_epochs == 0:
+                if cfg.score_calculator is not None:
+                    score = cfg.score_calculator.calculate_score(net)
+                else:
+                    score = net.score()
+                score_vs_epoch[epoch] = score
+                if best_score is None or score < best_score:
+                    best_score = score
+                    best_epoch = epoch
+                    cfg.model_saver.save_best_model(net, score)
+                if cfg.save_last_model:
+                    cfg.model_saver.save_latest_model(net, score)
+                for c in cfg.epoch_termination_conditions:
+                    if c.terminate(epoch, score):
+                        reason = TerminationReason.EPOCH_TERMINATION
+                        details = f"{type(c).__name__} at epoch {epoch}"
+                        break
+            epoch += 1
+
+        logger.info("Early stopping: %s (%s); best epoch %d score %s",
+                    reason, details, best_epoch, best_score)
+        best_model = cfg.model_saver.get_best_model(like_net=net)
+        return EarlyStoppingResult(
+            termination_reason=reason,
+            termination_details=details,
+            score_vs_epoch=score_vs_epoch,
+            best_model_epoch=best_epoch,
+            best_model_score=(float("nan") if best_score is None
+                              else best_score),
+            total_epochs=epoch,
+            best_model=best_model,
+        )

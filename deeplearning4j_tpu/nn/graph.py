@@ -238,9 +238,15 @@ class ComputationGraph:
                     rnn_carries=carries if with_carries else None)
             grads = self._clip_grads(grads)
             lr = schedule_lr(conf, step)
+            frozen = {n.name for n in self.topo
+                      if n.kind == "layer" and n.obj.frozen}
             new_params = {}
             new_upd = {}
             for name in layer_names:
+                if name in frozen:
+                    new_params[name] = params[name]
+                    new_upd[name] = upd_states[name]
+                    continue
                 deltas, us = updaters[name].update(
                     grads[name], upd_states[name], params[name],
                     lr * lr_factors[name], step)
@@ -253,7 +259,10 @@ class ComputationGraph:
 
     def _train_step(self, inputs, labels, fmasks=None, lmasks=None,
                     carries=None):
-        key = "train_c" if carries is not None else "train"
+        # cache key includes frozen flags: they're baked into the trace
+        frozen_sig = tuple(sorted(n.name for n in self.topo
+                                  if n.kind == "layer" and n.obj.frozen))
+        key = ("train_c" if carries is not None else "train", frozen_sig)
         if key not in self._jit_cache:
             self._jit_cache[key] = self._build_train_step(carries is not None)
         self._rng, sub = jax.random.split(self._rng)
@@ -312,6 +321,7 @@ class ComputationGraph:
         inputs = {name: jnp.asarray(x, self.dtype)
                   for name, x in zip(conf.network_inputs, ins)}
         labels = [jnp.asarray(y, self.dtype) for y in labs]
+        self._last_batch_size = int(next(iter(inputs.values())).shape[0])
         fmasks = None
         if fms is not None:
             fmasks = {name: (None if m is None else jnp.asarray(m, self.dtype))

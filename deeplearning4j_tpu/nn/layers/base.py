@@ -38,6 +38,9 @@ class Layer:
     """
 
     name: Optional[str] = None
+    # frozen layers keep their params fixed during fit (ref:
+    # nn/layers/FrozenLayer.java — here a flag instead of a wrapper class)
+    frozen: bool = False
     # None = inherit the global NeuralNetConfiguration default at build()
     dropout: Optional[float] = None  # inverted dropout on layer *input* in training
     l1: Optional[float] = None

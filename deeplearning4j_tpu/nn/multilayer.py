@@ -259,6 +259,10 @@ class MultiLayerNetwork:
             new_params = []
             new_upd = []
             for i in range(len(params)):
+                if conf.layers[i].frozen:
+                    new_params.append(params[i])
+                    new_upd.append(upd_states[i])
+                    continue
                 deltas, us = updaters[i].update(
                     grads[i], upd_states[i], params[i],
                     lr * lr_factors[i], step)
@@ -270,7 +274,11 @@ class MultiLayerNetwork:
         return jax.jit(step_fn, donate_argnums=(0, 1, 2))
 
     def _train_step(self, x, y, fmask=None, lmask=None, carries=None):
-        key = "train_c" if carries is not None else "train"
+        # frozen flags are baked into the traced step; key the cache on
+        # them so freezing/unfreezing between fits takes effect
+        frozen_sig = tuple(i for i, l in enumerate(self.conf.layers)
+                           if l.frozen)
+        key = ("train_c" if carries is not None else "train", frozen_sig)
         if key not in self._jit_cache:
             self._jit_cache[key] = self._build_train_step(carries is not None)
         self._rng, sub = jax.random.split(self._rng)
@@ -316,6 +324,7 @@ class MultiLayerNetwork:
                 x, y, fm, lm = _as_batch(batch)
                 x = jnp.asarray(x, self.dtype)
                 y = jnp.asarray(y, self.dtype)
+                self._last_batch_size = int(x.shape[0])
                 fm = None if fm is None else jnp.asarray(fm, self.dtype)
                 lm = None if lm is None else jnp.asarray(lm, self.dtype)
                 if (self.conf.backprop_type == BackpropType.TRUNCATED_BPTT
@@ -464,6 +473,8 @@ class MultiLayerNetwork:
             self.init()
         for li, layer in enumerate(self.conf.layers):
             if not isinstance(layer, (AutoEncoder, VariationalAutoencoder)):
+                continue
+            if layer.frozen:
                 continue
             upd = get_updater(layer.updater or self.conf.updater, self.conf)
             upd_state = upd.init(self.params[li])

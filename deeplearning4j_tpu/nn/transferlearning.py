@@ -1,0 +1,269 @@
+"""Transfer learning (parity: nn/transferlearning/TransferLearning.java:62
+— setFeatureExtractor :87, nOutReplace :101 — plus
+FineTuneConfiguration.java and TransferLearningHelper.java).
+
+Builder flow: take a trained MultiLayerNetwork, freeze a feature
+extractor prefix, optionally replace heads / append layers, override
+training hyperparameters, and get back a new network that keeps the old
+weights wherever architecture is unchanged.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+
+@dataclass
+class FineTuneConfiguration:
+    """Training-hyperparameter overrides applied to the rebuilt network
+    (ref: nn/transferlearning/FineTuneConfiguration.java)."""
+
+    updater: Optional[str] = None
+    learning_rate: Optional[float] = None
+    momentum: Optional[float] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    dropout: Optional[float] = None
+    seed: Optional[int] = None
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def updater(self, v):
+            self._kw["updater"] = str(v).lower()
+            return self
+
+        def learning_rate(self, v):
+            self._kw["learning_rate"] = float(v)
+            return self
+
+        def momentum(self, v):
+            self._kw["momentum"] = float(v)
+            return self
+
+        def l1(self, v):
+            self._kw["l1"] = float(v)
+            return self
+
+        def l2(self, v):
+            self._kw["l2"] = float(v)
+            return self
+
+        def dropout(self, v):
+            self._kw["dropout"] = float(v)
+            return self
+
+        def seed(self, v):
+            self._kw["seed"] = int(v)
+            return self
+
+        def build(self):
+            return FineTuneConfiguration(**self._kw)
+
+    def apply_to(self, conf):
+        if self.updater is not None:
+            conf.updater = self.updater
+        if self.learning_rate is not None:
+            conf.learning_rate = self.learning_rate
+        if self.momentum is not None:
+            conf.momentum = self.momentum
+        if self.seed is not None:
+            conf.seed = self.seed
+        for layer in conf.layers:
+            for f in ("l1", "l2", "dropout"):
+                v = getattr(self, f)
+                if v is not None and hasattr(layer, f):
+                    setattr(layer, f, v)
+
+
+class TransferLearning:
+    class Builder:
+        def __init__(self, net):
+            from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+            if not isinstance(net, MultiLayerNetwork):
+                raise TypeError(
+                    "TransferLearning.Builder works on MultiLayerNetwork; "
+                    "use TransferLearning.GraphBuilder for graphs")
+            if net.params is None:
+                raise ValueError("source network must be initialized")
+            self.net = net
+            self._ftc: Optional[FineTuneConfiguration] = None
+            self._freeze_up_to: Optional[int] = None
+            self._nout_replace = {}      # layer_idx -> (n_out, weight_init)
+            self._remove_from: Optional[int] = None
+            self._appended: List = []
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._ftc = ftc
+            return self
+
+        def set_feature_extractor(self, layer_idx: int):
+            """Freeze layers 0..layer_idx inclusive (ref: :87)."""
+            self._freeze_up_to = layer_idx
+            return self
+
+        def n_out_replace(self, layer_idx: int, n_out: int,
+                          weight_init: Optional[str] = None):
+            """Change a layer's output width; its params and the next
+            layer's input params are re-initialized (ref: :101)."""
+            self._nout_replace[layer_idx] = (n_out, weight_init)
+            return self
+
+        def remove_output_layer(self):
+            return self.remove_layers_from_output(1)
+
+        def remove_layers_from_output(self, n: int):
+            self._remove_from = len(self.net.conf.layers) - n
+            return self
+
+        def add_layer(self, layer):
+            self._appended.append(layer)
+            return self
+
+        def build(self):
+            from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+            old = self.net
+            conf = copy.deepcopy(old.conf)
+            n_old = len(conf.layers)
+            keep = n_old if self._remove_from is None else self._remove_from
+            appended = [copy.deepcopy(l) for l in self._appended]
+            for l in appended:
+                # appended layers bypass the global builder's default
+                # resolution; fill the framework defaults for None fields
+                if hasattr(l, "weight_init") and l.weight_init is None:
+                    l.weight_init = "xavier"
+                if hasattr(l, "activation") and l.activation is None:
+                    l.activation = "sigmoid"
+            conf.layers = conf.layers[:keep] + appended
+            conf.preprocessors = {i: p for i, p in conf.preprocessors.items()
+                                  if i < keep}
+
+            reinit = set(range(keep, len(conf.layers)))  # appended layers
+            for idx, (n_out, wi) in self._nout_replace.items():
+                if idx >= keep:
+                    raise ValueError(f"n_out_replace index {idx} was removed")
+                conf.layers[idx].n_out = n_out
+                if wi is not None:
+                    conf.layers[idx].weight_init = wi
+                reinit.add(idx)
+                if idx + 1 < len(conf.layers):
+                    reinit.add(idx + 1)  # its n_in changes
+
+            if self._freeze_up_to is not None:
+                for i in range(min(self._freeze_up_to + 1, len(conf.layers))):
+                    conf.layers[i].frozen = True
+            if self._ftc is not None:
+                self._ftc.apply_to(conf)
+
+            # re-resolve shapes (n_in of downstream layers)
+            for idx, layer in enumerate(conf.layers):
+                if idx in reinit and hasattr(layer, "n_in"):
+                    layer.n_in = None
+            conf.resolve_shapes()
+
+            new = MultiLayerNetwork(conf, dtype=old.dtype).init()
+            # copy retained params
+            for i in range(min(keep, len(conf.layers))):
+                if i in reinit:
+                    continue
+                old_p = old.params[i]
+                new_p = new.params[i]
+                same = (jax.tree_util.tree_structure(old_p)
+                        == jax.tree_util.tree_structure(new_p)
+                        and all(np.shape(a) == np.shape(b) for a, b in zip(
+                            jax.tree_util.tree_leaves(old_p),
+                            jax.tree_util.tree_leaves(new_p))))
+                if same:
+                    new.params[i] = copy.deepcopy(old_p)
+                    new.states[i] = copy.deepcopy(old.states[i])
+            return new
+
+    class GraphBuilder:
+        """Graph variant: freeze named vertices + replace outputs."""
+
+        def __init__(self, graph):
+            from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+            if not isinstance(graph, ComputationGraph):
+                raise TypeError("GraphBuilder needs a ComputationGraph")
+            if graph.params is None:
+                raise ValueError("source graph must be initialized")
+            self.graph = graph
+            self._ftc = None
+            self._frozen_until: Optional[str] = None
+
+        def fine_tune_configuration(self, ftc):
+            self._ftc = ftc
+            return self
+
+        def set_feature_extractor(self, node_name: str):
+            """Freeze node_name and every ancestor of it."""
+            self._frozen_until = node_name
+            return self
+
+        def build(self):
+            from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+            old = self.graph
+            conf = copy.deepcopy(old.conf)
+            if self._frozen_until is not None:
+                frozen = {self._frozen_until}
+                changed = True
+                by_name = {n.name: n for n in conf.nodes}
+                while changed:
+                    changed = False
+                    for name in list(frozen):
+                        node = by_name.get(name)
+                        if node is None:
+                            continue
+                        for src in node.inputs:
+                            if src in by_name and src not in frozen:
+                                frozen.add(src)
+                                changed = True
+                for n in conf.nodes:
+                    if n.name in frozen and n.kind == "layer":
+                        n.obj.frozen = True
+            if self._ftc is not None:
+                if self._ftc.updater is not None:
+                    conf.updater = self._ftc.updater
+                if self._ftc.learning_rate is not None:
+                    conf.learning_rate = self._ftc.learning_rate
+                if self._ftc.seed is not None:
+                    conf.seed = self._ftc.seed
+            new = ComputationGraph(conf, dtype=old.dtype).init()
+            new.params = copy.deepcopy(old.params)
+            new.states = copy.deepcopy(old.states)
+            return new
+
+
+class TransferLearningHelper:
+    """Featurize-once helper (ref: TransferLearningHelper.java): run the
+    frozen prefix once per dataset, then train only the unfrozen tail on
+    the cached features."""
+
+    def __init__(self, net, frozen_up_to: int):
+        self.net = net
+        self.frozen_up_to = frozen_up_to
+
+    def featurize(self, x):
+        import jax.numpy as jnp
+
+        acts = x
+        net = self.net
+        acts = jnp.asarray(acts, net.dtype)
+        cur = acts
+        for i in range(self.frozen_up_to + 1):
+            if i in net.conf.preprocessors:
+                cur = net.conf.preprocessors[i].preprocess(cur)
+            cur, _ = net.conf.layers[i].apply(
+                net.params[i], cur, train=False,
+                state=net.states[i] if net.states[i] else None)
+        return np.asarray(cur)

@@ -1,0 +1,10 @@
+from deeplearning4j_tpu.optimize.listeners import (  # noqa: F401
+    CheckpointListener,
+    CollectScoresIterationListener,
+    EvaluativeListener,
+    InvocationType,
+    PerformanceListener,
+    ScoreIterationListener,
+    SleepyTrainingListener,
+    TimeIterationListener,
+)

@@ -1,0 +1,216 @@
+"""Training listeners (parity: deeplearning4j-nn optimize/listeners/ —
+ScoreIterationListener, PerformanceListener.java:21-70 samples/batches per
+sec, EvaluativeListener w/ InvocationType, CollectScoresIterationListener,
+TimeIterationListener, SleepyTrainingListener, CheckpointListener role of
+earlystopping savers).
+
+Contract: `iteration_done(model, iteration)` each step; optional
+`on_epoch_start/on_epoch_end(model)`.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, List, Optional, Tuple
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class ScoreIterationListener:
+    """Log the loss every N iterations (ref: ScoreIterationListener.java)."""
+
+    def __init__(self, print_iterations: int = 10, log=None):
+        self.n = max(1, print_iterations)
+        self.log = log or (lambda msg: logger.info(msg))
+
+    def iteration_done(self, model, iteration: int):
+        if iteration % self.n == 0:
+            self.log(f"Score at iteration {iteration} is {model.score()}")
+
+
+class PerformanceListener:
+    """Throughput reporting (ref: PerformanceListener.java:21-70)."""
+
+    def __init__(self, frequency: int = 10, report_samples: bool = True,
+                 log=None):
+        self.frequency = max(1, frequency)
+        self.report_samples = report_samples
+        self.log = log or (lambda msg: logger.info(msg))
+        self._last_time = None
+        self._last_iter = None
+        self.samples_per_sec = None
+        self.batches_per_sec = None
+
+    def iteration_done(self, model, iteration: int):
+        now = time.perf_counter()
+        if self._last_time is not None and iteration % self.frequency == 0:
+            dt = now - self._last_time
+            n_batches = iteration - self._last_iter
+            if dt > 0 and n_batches > 0:
+                self.batches_per_sec = n_batches / dt
+                msg = (f"iteration {iteration}: "
+                       f"{self.batches_per_sec:.2f} batches/sec")
+                batch = getattr(model, "_last_batch_size", None)
+                if self.report_samples and batch:
+                    self.samples_per_sec = self.batches_per_sec * batch
+                    msg += f", {self.samples_per_sec:.1f} samples/sec"
+                self.log(msg)
+                self._last_time = now
+                self._last_iter = iteration
+        elif self._last_time is None:
+            self._last_time = now
+            self._last_iter = iteration
+
+
+class InvocationType:
+    ITERATION_END = "iteration_end"
+    EPOCH_END = "epoch_end"
+    EPOCH_START = "epoch_start"
+
+
+class EvaluativeListener:
+    """Run an evaluation on a held-out iterator during training
+    (ref: EvaluativeListener.java w/ InvocationType)."""
+
+    def __init__(self, iterator, frequency: int = 1,
+                 invocation_type: str = InvocationType.EPOCH_END,
+                 evaluation=None, callback: Optional[Callable] = None):
+        from deeplearning4j_tpu.eval import Evaluation
+
+        self.iterator = iterator
+        self.frequency = max(1, frequency)
+        self.invocation_type = invocation_type
+        self._eval_factory = evaluation or (lambda: Evaluation())
+        self.callback = callback
+        self.evaluations: List = []
+        self._count = 0
+
+    def _evaluate(self, model):
+        import numpy as np
+
+        ev = self._eval_factory()
+        if hasattr(self.iterator, "reset"):
+            self.iterator.reset()
+        for batch in self.iterator:
+            x = batch.features if hasattr(batch, "features") else batch[0]
+            y = batch.labels if hasattr(batch, "features") else batch[1]
+            out = model.output(x)
+            ev.eval(y, np.asarray(out))
+        self.evaluations.append(ev)
+        if self.callback:
+            self.callback(model, ev)
+        else:
+            logger.info("EvaluativeListener:\n%s", ev.stats())
+
+    def _maybe(self, model, kind):
+        if kind != self.invocation_type:
+            return
+        self._count += 1
+        if self._count % self.frequency == 0:
+            self._evaluate(model)
+
+    def iteration_done(self, model, iteration: int):
+        self._maybe(model, InvocationType.ITERATION_END)
+
+    def on_epoch_start(self, model):
+        self._maybe(model, InvocationType.EPOCH_START)
+
+    def on_epoch_end(self, model):
+        self._maybe(model, InvocationType.EPOCH_END)
+
+
+class CollectScoresIterationListener:
+    """Accumulate (iteration, score) pairs
+    (ref: CollectScoresIterationListener.java)."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, frequency)
+        self.scores: List[Tuple[int, float]] = []
+
+    def iteration_done(self, model, iteration: int):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, model.score()))
+
+    def export_scores(self, path, delimiter=","):
+        with open(path, "w") as f:
+            f.write(f"iteration{delimiter}score\n")
+            for it, s in self.scores:
+                f.write(f"{it}{delimiter}{s}\n")
+
+
+class TimeIterationListener:
+    """ETA logging (ref: TimeIterationListener.java)."""
+
+    def __init__(self, total_iterations: int, frequency: int = 1, log=None):
+        self.total = total_iterations
+        self.frequency = max(1, frequency)
+        self.log = log or (lambda msg: logger.info(msg))
+        self._start = time.time()
+
+    def iteration_done(self, model, iteration: int):
+        if iteration % self.frequency:
+            return
+        elapsed = time.time() - self._start
+        if iteration > 0:
+            remaining = elapsed / iteration * (self.total - iteration)
+            self.log(f"iteration {iteration}/{self.total}, "
+                     f"ETA {remaining:.0f}s")
+
+
+class SleepyTrainingListener:
+    """Inject pauses for debugging/throttling
+    (ref: SleepyTrainingListener.java)."""
+
+    def __init__(self, timer_iteration_ms: float = 0.0,
+                 timer_epoch_ms: float = 0.0):
+        self.timer_iteration_ms = timer_iteration_ms
+        self.timer_epoch_ms = timer_epoch_ms
+
+    def iteration_done(self, model, iteration: int):
+        if self.timer_iteration_ms:
+            time.sleep(self.timer_iteration_ms / 1e3)
+
+    def on_epoch_end(self, model):
+        if self.timer_epoch_ms:
+            time.sleep(self.timer_epoch_ms / 1e3)
+
+
+class CheckpointListener:
+    """Periodic model checkpoints (the reference exposes this via early-
+    stopping savers and the later CheckpointListener)."""
+
+    def __init__(self, directory, every_n_iterations: int = 0,
+                 every_n_epochs: int = 1, keep_last: int = 3):
+        import os
+
+        self.directory = str(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.every_n_iterations = every_n_iterations
+        self.every_n_epochs = every_n_epochs
+        self.keep_last = keep_last
+        self._saved: List[str] = []
+
+    def _save(self, model, tag):
+        import os
+
+        from deeplearning4j_tpu.util.model_serializer import write_model
+
+        path = os.path.join(self.directory, f"checkpoint_{tag}.zip")
+        write_model(model, path)
+        self._saved.append(path)
+        while len(self._saved) > self.keep_last:
+            old = self._saved.pop(0)
+            try:
+                os.remove(old)
+            except OSError:
+                pass
+
+    def iteration_done(self, model, iteration: int):
+        if self.every_n_iterations and iteration > 0 \
+                and iteration % self.every_n_iterations == 0:
+            self._save(model, f"iter{iteration}")
+
+    def on_epoch_end(self, model):
+        if self.every_n_epochs and model.epoch % self.every_n_epochs == 0:
+            self._save(model, f"epoch{model.epoch}")

@@ -1,0 +1,186 @@
+"""Listeners, early stopping, transfer learning tests (ref:
+deeplearning4j-core earlystopping/ and transferlearning tests)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.earlystopping import (
+    DataSetLossCalculator,
+    EarlyStoppingConfiguration,
+    EarlyStoppingTrainer,
+    InMemoryModelSaver,
+    InvalidScoreIterationTerminationCondition,
+    LocalFileModelSaver,
+    MaxEpochsTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+)
+from deeplearning4j_tpu.nn.conf import InputType
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.transferlearning import (
+    FineTuneConfiguration,
+    TransferLearning,
+    TransferLearningHelper,
+)
+from deeplearning4j_tpu.optimize import (
+    CollectScoresIterationListener,
+    EvaluativeListener,
+    PerformanceListener,
+    ScoreIterationListener,
+)
+
+
+def _net(n_in=6, n_out=3, seed=11, lr=0.05):
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(seed).updater("sgd").learning_rate(lr)
+            .activation("tanh").weight_init("xavier").list()
+            .layer(DenseLayer(n_out=10))
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=n_out, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(n_in))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(rng, n=60, d=6, c=3):
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d, c))
+    y = np.eye(c, dtype=np.float32)[(x @ w).argmax(1)]
+    return DataSet(x, y)
+
+
+def test_listeners_fire(rng):
+    net = _net()
+    ds = _data(rng)
+    logs = []
+    collect = CollectScoresIterationListener()
+    net.set_listeners(
+        ScoreIterationListener(1, log=logs.append),
+        PerformanceListener(2, log=logs.append),
+        collect)
+    net.fit(ListDataSetIterator(ds, batch_size=20), epochs=2)
+    assert len(collect.scores) == 6
+    assert any("Score at iteration" in l for l in logs)
+
+
+def test_evaluative_listener(rng):
+    net = _net()
+    ds = _data(rng)
+    evs = []
+    lis = EvaluativeListener(ListDataSetIterator(ds, 30),
+                             callback=lambda m, e: evs.append(e))
+    net.set_listeners(lis)
+    net.fit(ListDataSetIterator(ds, 20), epochs=2)
+    assert len(evs) == 2
+    assert 0.0 <= evs[-1].accuracy() <= 1.0
+
+
+def test_early_stopping_max_epochs(rng):
+    net = _net()
+    ds = _data(rng)
+    cfg = (EarlyStoppingConfiguration.Builder()
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(3))
+           .iteration_termination_conditions(
+               InvalidScoreIterationTerminationCondition())
+           .score_calculator(DataSetLossCalculator(
+               ListDataSetIterator(ds, 30)))
+           .model_saver(InMemoryModelSaver())
+           .build())
+    result = EarlyStoppingTrainer(
+        cfg, net, ListDataSetIterator(ds, 20)).fit()
+    assert result.termination_reason == "epoch_termination_condition"
+    assert result.total_epochs == 3
+    assert result.best_model is not None
+    assert result.best_model_score <= max(result.score_vs_epoch.values())
+
+
+def test_early_stopping_score_improvement(rng):
+    net = _net(lr=0.0)  # lr 0: no improvement ever
+    ds = _data(rng)
+    cfg = (EarlyStoppingConfiguration.Builder()
+           .epoch_termination_conditions(
+               ScoreImprovementEpochTerminationCondition(2),
+               MaxEpochsTerminationCondition(50))
+           .score_calculator(DataSetLossCalculator(
+               ListDataSetIterator(ds, 30)))
+           .build())
+    result = EarlyStoppingTrainer(
+        cfg, net, ListDataSetIterator(ds, 20)).fit()
+    assert result.total_epochs <= 5
+
+
+def test_early_stopping_local_file_saver(rng, tmp_path):
+    net = _net()
+    ds = _data(rng)
+    cfg = (EarlyStoppingConfiguration.Builder()
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(2))
+           .score_calculator(DataSetLossCalculator(
+               ListDataSetIterator(ds, 30)))
+           .model_saver(LocalFileModelSaver(tmp_path))
+           .build())
+    result = EarlyStoppingTrainer(
+        cfg, net, ListDataSetIterator(ds, 20)).fit()
+    assert (tmp_path / "bestModel.zip").exists()
+    assert result.best_model is not None
+
+
+def test_transfer_learning_freeze_and_replace(rng):
+    src = _net()
+    ds = _data(rng)
+    src.fit(ListDataSetIterator(ds, 20), epochs=2)
+    p0 = np.asarray(src.params[0]["W"]).copy()
+
+    new = (TransferLearning.Builder(src)
+           .fine_tune_configuration(
+               FineTuneConfiguration.Builder().updater("sgd")
+               .learning_rate(0.1).build())
+           .set_feature_extractor(1)
+           .n_out_replace(2, 5, weight_init="xavier")
+           .build())
+    # frozen layers keep source weights
+    np.testing.assert_array_equal(np.asarray(new.params[0]["W"]), p0)
+    assert new.conf.layers[0].frozen and new.conf.layers[1].frozen
+    assert not new.conf.layers[2].frozen
+    assert new.conf.layers[2].n_out == 5
+
+    y5 = np.eye(5, dtype=np.float32)[rng.integers(0, 5, 60)]
+    new.fit([(ds.features, y5)] * 4)
+    # frozen params unchanged by training, head trained
+    np.testing.assert_array_equal(np.asarray(new.params[0]["W"]), p0)
+    assert np.asarray(new.output(ds.features)).shape == (60, 5)
+
+
+def test_transfer_learning_add_remove_layers(rng):
+    src = _net()
+    new = (TransferLearning.Builder(src)
+           .remove_output_layer()
+           .add_layer(DenseLayer(n_out=4, activation="relu"))
+           .add_layer(OutputLayer(n_out=2, loss="mcxent"))
+           .build())
+    assert len(new.conf.layers) == 4
+    x = rng.normal(size=(5, 6)).astype(np.float32)
+    assert np.asarray(new.output(x)).shape == (5, 2)
+
+
+def test_transfer_learning_helper_featurize(rng):
+    src = _net()
+    helper = TransferLearningHelper(src, frozen_up_to=1)
+    x = rng.normal(size=(7, 6)).astype(np.float32)
+    feats = helper.featurize(x)
+    assert feats.shape == (7, 8)
+    # featurized == full forward to layer 1
+    acts = src.feed_forward(x)
+    np.testing.assert_allclose(feats, np.asarray(acts[2]), rtol=1e-6)
+
+
+def test_checkpoint_listener(rng, tmp_path):
+    from deeplearning4j_tpu.optimize import CheckpointListener
+
+    net = _net()
+    ds = _data(rng)
+    net.set_listeners(CheckpointListener(tmp_path, every_n_epochs=1,
+                                         keep_last=2))
+    net.fit(ListDataSetIterator(ds, 30), epochs=3)
+    zips = list(tmp_path.glob("checkpoint_*.zip"))
+    assert len(zips) == 2  # keep_last pruned
