@@ -1,0 +1,29 @@
+"""NLP: embeddings + text pipeline (parity: deeplearning4j-nlp-parent —
+SequenceVectors framework, Word2Vec/ParagraphVectors/GloVe, tokenization,
+vocab, serialization; ref models/sequencevectors/SequenceVectors.java).
+
+TPU-native redesign: the reference trains embeddings with hogwild sparse
+updates on a host-resident table (SkipGram.java:224). That does not map
+to TPU; here training is mini-batched dense lookups + scatter-add updates
+inside one jit-compiled step (negative sampling and hierarchical softmax
+both), which is mathematically the same update applied batch-
+synchronously.
+"""
+
+from deeplearning4j_tpu.nlp.tokenization import (  # noqa: F401
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.sentence_iterator import (  # noqa: F401
+    BasicLineIterator,
+    CollectionSentenceIterator,
+)
+from deeplearning4j_tpu.nlp.vocab import AbstractCache, VocabWord  # noqa: F401
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec  # noqa: F401
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors  # noqa: F401
+from deeplearning4j_tpu.nlp.glove import Glove  # noqa: F401
+from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer  # noqa: F401
+from deeplearning4j_tpu.nlp.vectorizers import (  # noqa: F401
+    BagOfWordsVectorizer,
+    TfidfVectorizer,
+)

@@ -1,0 +1,112 @@
+"""GloVe (parity: models/glove/Glove.java + models/glove/count/
+cooccurrence counting). Host-side cooccurrence map, jit-compiled AdaGrad
+updates over batched (i, j, X_ij) triples — the reference's per-pair
+AdaGrad (AbstractCoOccurrences + GloveCalculations) batch-synchronously.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+
+
+class _GloveStep:
+    def __init__(self):
+        self._fn = None
+
+    def __call__(self, w, wc, b, bc, hw, hwc, hb, hbc, ii, jj, logx, fx, lr):
+        import jax
+        import jax.numpy as jnp
+
+        if self._fn is None:
+            def step(w, wc, b, bc, hw, hwc, hb, hbc, ii, jj, logx, fx, lr):
+                wi = w[ii]
+                wj = wc[jj]
+                diff = jnp.einsum("bd,bd->b", wi, wj) + b[ii] + bc[jj] - logx
+                fdiff = fx * diff                      # [B]
+                # grads
+                gwi = fdiff[:, None] * wj
+                gwj = fdiff[:, None] * wi
+                gbi = fdiff
+                gbj = fdiff
+                # adagrad accumulators
+                hw = hw.at[ii].add(gwi * gwi)
+                hwc = hwc.at[jj].add(gwj * gwj)
+                hb = hb.at[ii].add(gbi * gbi)
+                hbc = hbc.at[jj].add(gbj * gbj)
+                eps = 1e-8
+                w = w.at[ii].add(-lr * gwi / jnp.sqrt(hw[ii] + eps))
+                wc = wc.at[jj].add(-lr * gwj / jnp.sqrt(hwc[jj] + eps))
+                b = b.at[ii].add(-lr * gbi / jnp.sqrt(hb[ii] + eps))
+                bc = bc.at[jj].add(-lr * gbj / jnp.sqrt(hbc[jj] + eps))
+                loss = 0.5 * jnp.mean(fx * diff * diff)
+                return w, wc, b, bc, hw, hwc, hb, hbc, loss
+
+            self._fn = jax.jit(step, donate_argnums=tuple(range(8)))
+        return self._fn(w, wc, b, bc, hw, hwc, hb, hbc, ii, jj, logx, fx, lr)
+
+
+class Glove(SequenceVectors):
+    def __init__(self, x_max: float = 100.0, alpha: float = 0.75, **kw):
+        kw.setdefault("learning_rate", 0.05)
+        super().__init__(**kw)
+        self.x_max = x_max
+        self.alpha = alpha
+        self._step = _GloveStep()
+
+    def _cooccurrences(self, seqs) -> Dict[Tuple[int, int], float]:
+        co: Dict[Tuple[int, int], float] = {}
+        for seq in seqs:
+            idxs = [self.vocab.index_of(t) for t in seq]
+            idxs = [i for i in idxs if i >= 0]
+            for pos, center in enumerate(idxs):
+                for off in range(1, self.window + 1):
+                    j = pos + off
+                    if j >= len(idxs):
+                        break
+                    a, c = center, idxs[j]
+                    wgt = 1.0 / off
+                    co[(a, c)] = co.get((a, c), 0.0) + wgt
+                    co[(c, a)] = co.get((c, a), 0.0) + wgt
+        return co
+
+    def fit(self, sequences: Iterable[Sequence[str]]):
+        seqs = [list(s) for s in sequences]
+        if self.syn0 is None:
+            self.build_vocab(seqs)
+        co = self._cooccurrences(seqs)
+        if not co:
+            return self
+        V = self.vocab.num_words()
+        D = self.layer_size
+        rng = np.random.default_rng(self.seed)
+        import jax.numpy as jnp
+
+        w = jnp.asarray((rng.random((V, D)) - 0.5).astype(np.float32) / D)
+        wc = jnp.asarray((rng.random((V, D)) - 0.5).astype(np.float32) / D)
+        b = jnp.zeros(V, jnp.float32)
+        bc = jnp.zeros(V, jnp.float32)
+        hw = jnp.ones((V, D), jnp.float32)
+        hwc = jnp.ones((V, D), jnp.float32)
+        hb = jnp.ones(V, jnp.float32)
+        hbc = jnp.ones(V, jnp.float32)
+
+        pairs = np.asarray(list(co.keys()), np.int32)
+        xs = np.asarray(list(co.values()), np.float32)
+        logx = np.log(xs)
+        fx = np.minimum(1.0, (xs / self.x_max) ** self.alpha).astype(np.float32)
+        B = min(self.batch_size, len(pairs))
+        lr = jnp.float32(self.learning_rate)
+        for _ in range(self.epochs):
+            order = rng.permutation(len(pairs))
+            for s in range(0, len(order) - B + 1, B):
+                sel = order[s:s + B]
+                w, wc, b, bc, hw, hwc, hb, hbc, _ = self._step(
+                    w, wc, b, bc, hw, hwc, hb, hbc,
+                    jnp.asarray(pairs[sel, 0]), jnp.asarray(pairs[sel, 1]),
+                    jnp.asarray(logx[sel]), jnp.asarray(fx[sel]), lr)
+        self.syn0 = np.asarray(w) + np.asarray(wc)  # standard GloVe sum
+        return self

@@ -1,0 +1,264 @@
+"""ParagraphVectors / doc2vec (parity: models/paragraphvectors/
+ParagraphVectors.java with sequence-learning algorithms DBOW and DM —
+models/embeddings/learning/impl/sequence/{DBOW,DM}.java).
+
+DBOW: the doc vector predicts sampled context words (negative sampling).
+DM: mean of (context word vectors + doc vector) predicts the center word.
+Both run as jit-compiled batched steps over padded windows.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.sequence_vectors import (
+    SequenceVectors,
+    _NegSamplingStep,
+)
+from deeplearning4j_tpu.nlp.sentence_iterator import LabelledDocument
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+
+
+class _DMStep:
+    def __init__(self):
+        self._fn = None
+
+    def __call__(self, syn0, docvecs, syn1neg, ctx, ctx_mask, doc_ids,
+                 targets, labels, lr):
+        import jax
+        import jax.numpy as jnp
+
+        if self._fn is None:
+            def step(syn0, docvecs, syn1neg, ctx, ctx_mask, doc_ids,
+                     targets, labels, lr):
+                cw = syn0[ctx] * ctx_mask[..., None]      # [B,W,D]
+                n_ctx = jnp.sum(ctx_mask, axis=1, keepdims=True)  # [B,1]
+                dv = docvecs[doc_ids]                     # [B,D]
+                denom = n_ctx + 1.0
+                h = (jnp.sum(cw, axis=1) + dv) / denom    # [B,D]
+                u = syn1neg[targets]                      # [B,K,D]
+                p = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", h, u))
+                g = (labels - p) * lr
+                dh = jnp.einsum("bk,bkd->bd", g, u) / denom
+                du = jnp.einsum("bk,bd->bkd", g, h)
+                # per-row 1/sqrt(count) scaling over in-batch duplicates (see sequence_vectors)
+                flat_t = targets.reshape(-1)
+                t_cnt = jnp.zeros(syn1neg.shape[0]).at[flat_t].add(1.0)
+                syn1neg = syn1neg.at[flat_t].add(
+                    du.reshape(-1, du.shape[-1]) / jnp.sqrt(t_cnt[flat_t])[:, None])
+                d_cnt = jnp.zeros(docvecs.shape[0]).at[doc_ids].add(1.0)
+                docvecs = docvecs.at[doc_ids].add(
+                    dh / jnp.sqrt(d_cnt[doc_ids])[:, None])
+                dctx = dh[:, None, :] * ctx_mask[..., None]
+                flat_c = ctx.reshape(-1)
+                c_cnt = jnp.zeros(syn0.shape[0]).at[flat_c].add(
+                    ctx_mask.reshape(-1))
+                syn0 = syn0.at[flat_c].add(
+                    dctx.reshape(-1, dctx.shape[-1])
+                    / jnp.sqrt(jnp.maximum(c_cnt, 1.0))[flat_c][:, None])
+                eps = 1e-7
+                loss = -jnp.mean(labels * jnp.log(p + eps)
+                                 + (1 - labels) * jnp.log(1 - p + eps))
+                return syn0, docvecs, syn1neg, loss
+
+            self._fn = jax.jit(step, donate_argnums=(0, 1, 2))
+        return self._fn(syn0, docvecs, syn1neg, ctx, ctx_mask, doc_ids,
+                        targets, labels, lr)
+
+
+class ParagraphVectors(SequenceVectors):
+    def __init__(self, dm: bool = False, **kw):
+        self._tokenizer_factory = kw.pop("tokenizer_factory",
+                                         DefaultTokenizerFactory())
+        self._label_iterator = kw.pop("iterate_labelled", None)
+        super().__init__(**kw)
+        self.dm = dm
+        self.doc_vectors: Optional[np.ndarray] = None
+        self.labels: List[str] = []
+        self._label_index: Dict[str, int] = {}
+        self._dm_step = _DMStep()
+        self._infer_step = _NegSamplingStep()
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+            self._docs = None
+            self._tok = None
+            self._dm = False
+
+        def layer_size(self, v):
+            self._kw["layer_size"] = int(v)
+            return self
+
+        def window_size(self, v):
+            self._kw["window"] = int(v)
+            return self
+
+        def negative_sample(self, v):
+            self._kw["negative"] = int(v)
+            return self
+
+        def min_word_frequency(self, v):
+            self._kw["min_word_frequency"] = int(v)
+            return self
+
+        def learning_rate(self, v):
+            self._kw["learning_rate"] = float(v)
+            return self
+
+        def epochs(self, v):
+            self._kw["epochs"] = int(v)
+            return self
+
+        def batch_size(self, v):
+            self._kw["batch_size"] = int(v)
+            return self
+
+        def seed(self, v):
+            self._kw["seed"] = int(v)
+            return self
+
+        def sequence_learning_algorithm(self, name: str):
+            self._dm = "dm" in str(name).lower()
+            return self
+
+        def iterate(self, label_aware_iterator):
+            self._docs = label_aware_iterator
+            return self
+
+        def tokenizer_factory(self, tf):
+            self._tok = tf
+            return self
+
+        def build(self) -> "ParagraphVectors":
+            pv = ParagraphVectors(dm=self._dm, **self._kw)
+            pv._label_iterator = self._docs
+            if self._tok is not None:
+                pv._tokenizer_factory = self._tok
+            return pv
+
+    # ------------------------------------------------------------------
+    def fit(self, documents: Optional[Iterable[LabelledDocument]] = None):
+        docs = list(documents if documents is not None
+                    else self._label_iterator)
+        token_seqs = []
+        doc_labels = []
+        for d in docs:
+            toks = self._tokenizer_factory.create(d.content).get_tokens()
+            token_seqs.append(toks)
+            doc_labels.append(d.labels[0] if d.labels else f"DOC_{len(doc_labels)}")
+        self.build_vocab(token_seqs)
+        self.labels = doc_labels
+        self._label_index = {l: i for i, l in enumerate(doc_labels)}
+        rng = np.random.default_rng(self.seed)
+        self.doc_vectors = ((rng.random((len(docs), self.layer_size)) - 0.5)
+                            / self.layer_size).astype(np.float32)
+
+        import jax.numpy as jnp
+
+        syn0 = jnp.asarray(self.syn0)
+        syn1neg = jnp.asarray(self.syn1neg)
+        docvecs = jnp.asarray(self.doc_vectors)
+        total = max(1, sum(len(s) for s in token_seqs) * self.epochs)
+        seen = 0
+        for _ in range(self.epochs):
+            for di in rng.permutation(len(token_seqs)):
+                idxs = self._sequence_indices(token_seqs[di], rng)
+                if not idxs:
+                    continue
+                lr = jnp.float32(self._lr(seen, total))
+                seen += len(idxs)
+                if self.dm:
+                    syn0, docvecs, syn1neg = self._fit_dm_doc(
+                        syn0, docvecs, syn1neg, idxs, di, rng, lr)
+                else:
+                    syn0, docvecs, syn1neg = self._fit_dbow_doc(
+                        syn0, docvecs, syn1neg, idxs, di, rng, lr)
+        self.syn0 = np.asarray(syn0)
+        self.syn1neg = np.asarray(syn1neg)
+        self.doc_vectors = np.asarray(docvecs)
+        return self
+
+    def _neg_targets(self, idxs, rng):
+        B = len(idxs)
+        K = self.negative
+        neg = rng.choice(self.vocab.num_words(), size=(B, K),
+                         p=self._unigram)
+        tgt = np.concatenate([np.asarray(idxs)[:, None], neg], 1)
+        labels = np.zeros((B, K + 1), np.float32)
+        labels[:, 0] = 1.0
+        return tgt.astype(np.int32), labels
+
+    def _fit_dbow_doc(self, syn0, docvecs, syn1neg, idxs, di, rng, lr):
+        """Doc vector predicts each word (PV-DBOW)."""
+        import jax.numpy as jnp
+
+        tgt, labels = self._neg_targets(idxs, rng)
+        doc_ids = np.full(len(idxs), di, np.int32)
+        # reuse the skip-gram step with docvecs as the "center" table
+        docvecs, syn1neg, _ = self._infer_step(
+            docvecs, syn1neg, jnp.asarray(doc_ids), jnp.asarray(tgt),
+            jnp.asarray(labels), lr)
+        return syn0, docvecs, syn1neg
+
+    def _fit_dm_doc(self, syn0, docvecs, syn1neg, idxs, di, rng, lr):
+        import jax.numpy as jnp
+
+        W = 2 * self.window
+        n = len(idxs)
+        ctx = np.zeros((n, W), np.int32)
+        cmask = np.zeros((n, W), np.float32)
+        for pos in range(n):
+            c = 0
+            for off in range(-self.window, self.window + 1):
+                j = pos + off
+                if off == 0 or not (0 <= j < n):
+                    continue
+                ctx[pos, c] = idxs[j]
+                cmask[pos, c] = 1.0
+                c += 1
+        tgt, labels = self._neg_targets(idxs, rng)
+        doc_ids = np.full(n, di, np.int32)
+        syn0, docvecs, syn1neg, _ = self._dm_step(
+            syn0, docvecs, syn1neg, jnp.asarray(ctx), jnp.asarray(cmask),
+            jnp.asarray(doc_ids), jnp.asarray(tgt), jnp.asarray(labels), lr)
+        return syn0, docvecs, syn1neg
+
+    # ------------------------------------------------------------------
+    def get_doc_vector(self, label: str) -> Optional[np.ndarray]:
+        i = self._label_index.get(label)
+        return None if i is None else self.doc_vectors[i]
+
+    def similarity_doc(self, a: str, b: str) -> float:
+        va, vb = self.get_doc_vector(a), self.get_doc_vector(b)
+        denom = np.linalg.norm(va) * np.linalg.norm(vb)
+        return float(va @ vb / denom) if denom else 0.0
+
+    def infer_vector(self, text: str, steps: int = 20,
+                     learning_rate: float = 0.025) -> np.ndarray:
+        """Gradient-fit a fresh doc vector with word tables frozen
+        (ref: ParagraphVectors.inferVector)."""
+        import jax.numpy as jnp
+
+        toks = self._tokenizer_factory.create(text).get_tokens()
+        rng = np.random.default_rng(self.seed + 7)
+        idxs = [self.vocab.index_of(t) for t in toks]
+        idxs = [i for i in idxs if i >= 0]
+        if not idxs:
+            return np.zeros(self.layer_size, np.float32)
+        vec = ((rng.random((1, self.layer_size)) - 0.5)
+               / self.layer_size).astype(np.float32)
+        vecj = jnp.asarray(vec)
+        syn1neg = jnp.asarray(self.syn1neg)
+        for s in range(steps):
+            tgt, labels = self._neg_targets(idxs, rng)
+            doc_ids = np.zeros(len(idxs), np.int32)
+            lr = jnp.float32(learning_rate * (1 - s / steps)
+                             + 1e-4 * s / steps)
+            vecj, syn1neg_new, _ = self._infer_step(
+                vecj, syn1neg, jnp.asarray(doc_ids), jnp.asarray(tgt),
+                jnp.asarray(labels), lr)
+            syn1neg = jnp.asarray(self.syn1neg)  # keep word table frozen
+        return np.asarray(vecj)[0]

@@ -1,0 +1,68 @@
+"""Sentence/document iterators (parity: deeplearning4j-nlp
+text/sentenceiterator/ — BasicLineIterator, CollectionSentenceIterator,
+with optional SentencePreProcessor) and LabelAwareIterator for
+ParagraphVectors (text/documentiterator/)."""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, List, Optional
+
+
+class SentenceIterator:
+    def __iter__(self):
+        self.reset()
+        return self._gen()
+
+    def _gen(self):
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+
+class CollectionSentenceIterator(SentenceIterator):
+    def __init__(self, sentences: Iterable[str],
+                 preprocessor: Optional[Callable[[str], str]] = None):
+        self.sentences = list(sentences)
+        self.preprocessor = preprocessor
+
+    def _gen(self):
+        for s in self.sentences:
+            yield self.preprocessor(s) if self.preprocessor else s
+
+
+class BasicLineIterator(SentenceIterator):
+    """One sentence per line from a file (ref: BasicLineIterator.java)."""
+
+    def __init__(self, path,
+                 preprocessor: Optional[Callable[[str], str]] = None):
+        self.path = str(path)
+        self.preprocessor = preprocessor
+
+    def _gen(self):
+        with open(self.path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    yield (self.preprocessor(line) if self.preprocessor
+                           else line)
+
+
+class LabelledDocument:
+    def __init__(self, content: str, labels: List[str]):
+        self.content = content
+        self.labels = labels
+
+
+class SimpleLabelAwareIterator:
+    """Documents with labels for ParagraphVectors
+    (ref: text/documentiterator/SimpleLabelAwareIterator.java)."""
+
+    def __init__(self, documents: Iterable[LabelledDocument]):
+        self.documents = list(documents)
+
+    def __iter__(self):
+        return iter(self.documents)
+
+    def reset(self):
+        pass

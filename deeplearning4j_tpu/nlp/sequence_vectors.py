@@ -1,0 +1,316 @@
+"""SequenceVectors: the generic embedding trainer
+(parity: models/sequencevectors/SequenceVectors.java — buildVocab :103,207,
+fit :187, worker loop :289; elements-learning algorithms SkipGram.java:31
+(iterateSample :224, HS :238, negative sampling :258) and CBOW.java).
+
+TPU-native redesign: the reference trains with multithreaded hogwild over
+a shared host table. Here, window extraction + negative sampling happen
+on host (numpy), and the math runs as jit-compiled batched steps with
+scatter-add updates — the same per-pair SGD update, applied batch-
+synchronously, MXU-friendly (batched [B,D] x [B,K,D] einsums).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.vocab import AbstractCache, build_huffman
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+class _NegSamplingStep:
+    """jit'd skip-gram negative-sampling update."""
+
+    def __init__(self):
+        self._fn = None
+
+    def __call__(self, syn0, syn1neg, center, ctx, labels, lr):
+        import jax
+        import jax.numpy as jnp
+
+        if self._fn is None:
+            def step(syn0, syn1neg, center, ctx, labels, lr):
+                v = syn0[center]                       # [B,D]
+                u = syn1neg[ctx]                       # [B,K,D]
+                logits = jnp.einsum("bd,bkd->bk", v, u)
+                p = jax.nn.sigmoid(logits)
+                g = (labels - p) * lr                  # [B,K]
+                dv = jnp.einsum("bk,bkd->bd", g, u)
+                du = jnp.einsum("bk,bd->bkd", g, v)
+                # scale each row's summed update by 1/sqrt(batch count):
+                # raw sums computed at the same old value multiply the
+                # effective lr by the row's batch frequency and collapse
+                # embeddings for small vocabs (hogwild applies updates
+                # sequentially); full 1/count under-trains frequent words
+                # — sqrt is the measured sweet spot
+                c_cnt = jnp.zeros(syn0.shape[0]).at[center].add(1.0)
+                dv = dv / jnp.sqrt(c_cnt[center])[:, None]
+                flat_ctx = ctx.reshape(-1)
+                x_cnt = jnp.zeros(syn1neg.shape[0]).at[flat_ctx].add(1.0)
+                du = (du.reshape(-1, du.shape[-1])
+                      / jnp.sqrt(x_cnt[flat_ctx])[:, None])
+                syn0 = syn0.at[center].add(dv)
+                syn1neg = syn1neg.at[flat_ctx].add(du)
+                # logistic loss for reporting
+                eps = 1e-7
+                loss = -jnp.mean(
+                    labels * jnp.log(p + eps)
+                    + (1 - labels) * jnp.log(1 - p + eps))
+                return syn0, syn1neg, loss
+
+            self._fn = jax.jit(step, donate_argnums=(0, 1))
+        return self._fn(syn0, syn1neg, center, ctx, labels, lr)
+
+
+class _HierarchicSoftmaxStep:
+    """jit'd skip-gram hierarchical-softmax update (SkipGram.java:238)."""
+
+    def __init__(self):
+        self._fn = None
+
+    def __call__(self, syn0, syn1, center, points, codes, mask, lr):
+        import jax
+        import jax.numpy as jnp
+
+        if self._fn is None:
+            def step(syn0, syn1, center, points, codes, mask, lr):
+                v = syn0[center]                       # [B,D]
+                u = syn1[points]                       # [B,L,D]
+                logits = jnp.einsum("bd,bld->bl", v, u)
+                p = jax.nn.sigmoid(logits)
+                # target: 1 - code
+                g = ((1.0 - codes) - p) * mask * lr
+                dv = jnp.einsum("bl,bld->bd", g, u)
+                du = jnp.einsum("bl,bd->bld", g, v)
+                # per-row 1/sqrt(count) scaling over in-batch duplicates (see neg-sampling)
+                c_cnt = jnp.zeros(syn0.shape[0]).at[center].add(1.0)
+                dv = dv / jnp.sqrt(c_cnt[center])[:, None]
+                flat_pts = points.reshape(-1)
+                flat_msk = mask.reshape(-1)
+                p_cnt = jnp.zeros(syn1.shape[0]).at[flat_pts].add(flat_msk)
+                du = (du.reshape(-1, du.shape[-1])
+                      / jnp.sqrt(jnp.maximum(p_cnt, 1.0))[flat_pts][:, None])
+                syn0 = syn0.at[center].add(dv)
+                syn1 = syn1.at[flat_pts].add(du)
+                eps = 1e-7
+                tgt = 1.0 - codes
+                ll = tgt * jnp.log(p + eps) + (1 - tgt) * jnp.log(1 - p + eps)
+                loss = -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+                return syn0, syn1, loss
+
+            self._fn = jax.jit(step, donate_argnums=(0, 1))
+        return self._fn(syn0, syn1, center, points, codes, mask, lr)
+
+
+class SequenceVectors:
+    """Generic embedding trainer over token sequences."""
+
+    def __init__(self, layer_size: int = 100, window: int = 5,
+                 negative: int = 5, use_hierarchic_softmax: bool = False,
+                 min_word_frequency: int = 1, learning_rate: float = 0.025,
+                 min_learning_rate: float = 1e-4, epochs: int = 1,
+                 batch_size: int = 512, sampling: float = 0.0,
+                 use_cbow: bool = False, seed: int = 42):
+        self.layer_size = layer_size
+        self.window = window
+        self.negative = negative
+        self.use_hs = use_hierarchic_softmax or negative <= 0
+        self.min_word_frequency = min_word_frequency
+        self.learning_rate = learning_rate
+        self.min_learning_rate = min_learning_rate
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.sampling = sampling
+        self.use_cbow = use_cbow
+        self.seed = seed
+
+        self.vocab = AbstractCache(min_word_frequency)
+        self.syn0: Optional[np.ndarray] = None
+        self.syn1: Optional[np.ndarray] = None      # HS inner nodes
+        self.syn1neg: Optional[np.ndarray] = None
+        self._unigram: Optional[np.ndarray] = None
+        self._max_code_len = 0
+        self._neg_step = _NegSamplingStep()
+        self._hs_step = _HierarchicSoftmaxStep()
+
+    # ------------------------------------------------------------- vocab
+    def build_vocab(self, sequences: Iterable[Sequence[str]]):
+        for seq in sequences:
+            for tok in seq:
+                self.vocab.add_token(tok)
+        self.vocab.finalize_vocab()
+        if self.use_hs:
+            self._max_code_len = build_huffman(self.vocab)
+        V = self.vocab.num_words()
+        rng = np.random.default_rng(self.seed)
+        self.syn0 = ((rng.random((V, self.layer_size)) - 0.5)
+                     / self.layer_size).astype(np.float32)
+        if self.use_hs:
+            self.syn1 = np.zeros((max(V - 1, 1), self.layer_size), np.float32)
+        if self.negative > 0:
+            self.syn1neg = np.zeros((V, self.layer_size), np.float32)
+            counts = self.vocab.counts() ** 0.75
+            self._unigram = (counts / counts.sum()).astype(np.float64)
+        return self
+
+    # ----------------------------------------------------------- pairs
+    def _sequence_indices(self, seq, rng):
+        idxs = [self.vocab.index_of(t) for t in seq]
+        idxs = [i for i in idxs if i >= 0]
+        if self.sampling > 0 and self.vocab.total_word_count > 0:
+            counts = self.vocab.counts()
+            total = counts.sum()
+            keep = []
+            for i in idxs:
+                f = counts[i] / total
+                p_keep = min(1.0, (np.sqrt(f / self.sampling) + 1)
+                             * self.sampling / f)
+                if rng.random() < p_keep:
+                    keep.append(i)
+            idxs = keep
+        return idxs
+
+    def _gen_pairs(self, sequences, rng):
+        """Yield (center, context) index pairs with the reference's random
+        reduced-window trick."""
+        for seq in sequences:
+            idxs = self._sequence_indices(seq, rng)
+            n = len(idxs)
+            for pos, center in enumerate(idxs):
+                b = rng.integers(1, self.window + 1)
+                for off in range(-b, b + 1):
+                    if off == 0:
+                        continue
+                    j = pos + off
+                    if 0 <= j < n:
+                        yield center, idxs[j]
+
+    # ------------------------------------------------------------- fit
+    def fit(self, sequences: Iterable[Sequence[str]]):
+        seqs = [list(s) for s in sequences]
+        if self.syn0 is None:
+            self.build_vocab(seqs)
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(self.seed + 1)
+        syn0 = jnp.asarray(self.syn0)
+        syn1 = None if self.syn1 is None else jnp.asarray(self.syn1)
+        syn1neg = (None if self.syn1neg is None
+                   else jnp.asarray(self.syn1neg))
+
+        # rough total pair count for the linear lr decay
+        approx_pairs = max(
+            1, sum(len(s) for s in seqs) * self.window * self.epochs)
+        seen = 0
+        for _ in range(self.epochs):
+            order = rng.permutation(len(seqs))
+            buf_c, buf_x = [], []
+            for si in order:
+                for c, x in self._gen_pairs([seqs[si]], rng):
+                    buf_c.append(c)
+                    buf_x.append(x)
+                    if len(buf_c) >= self.batch_size:
+                        syn0, syn1, syn1neg = self._flush(
+                            syn0, syn1, syn1neg, buf_c, buf_x, rng,
+                            seen, approx_pairs)
+                        seen += len(buf_c)
+                        buf_c, buf_x = [], []
+            if buf_c:
+                syn0, syn1, syn1neg = self._flush(
+                    syn0, syn1, syn1neg, buf_c, buf_x, rng, seen,
+                    approx_pairs)
+                seen += len(buf_c)
+        self.syn0 = np.asarray(syn0)
+        self.syn1 = None if syn1 is None else np.asarray(syn1)
+        self.syn1neg = None if syn1neg is None else np.asarray(syn1neg)
+        return self
+
+    def _lr(self, seen, total):
+        frac = min(1.0, seen / total)
+        return max(self.min_learning_rate,
+                   self.learning_rate * (1.0 - frac))
+
+    def _flush(self, syn0, syn1, syn1neg, buf_c, buf_x, rng, seen, total):
+        import jax.numpy as jnp
+
+        # pad the final ragged batch to the fixed batch size so the jit
+        # step compiles exactly once (padding rows use index 0 with lr
+        # masked via duplicate-safe zero labels trick: simpler — replicate
+        # last pair; the few duplicated updates are negligible)
+        B = self.batch_size
+        if len(buf_c) < B:
+            reps = B - len(buf_c)
+            buf_c = buf_c + [buf_c[-1]] * reps
+            buf_x = buf_x + [buf_x[-1]] * reps
+        center = jnp.asarray(np.asarray(buf_c, np.int32))
+        lr = jnp.float32(self._lr(seen, total))
+        if self.use_hs:
+            L = max(self._max_code_len, 1)
+            words = self.vocab.vocab_words()
+            pts = np.zeros((B, L), np.int32)
+            cds = np.zeros((B, L), np.float32)
+            msk = np.zeros((B, L), np.float32)
+            for i, x in enumerate(buf_x):
+                w = words[x]
+                l = len(w.codes)
+                pts[i, :l] = w.points
+                cds[i, :l] = w.codes
+                msk[i, :l] = 1.0
+            syn0, syn1, _ = self._hs_step(
+                syn0, syn1, center, jnp.asarray(pts), jnp.asarray(cds),
+                jnp.asarray(msk), lr)
+        if self.negative > 0:
+            K = self.negative
+            V = self.vocab.num_words()
+            neg = rng.choice(V, size=(B, K), p=self._unigram)
+            ctx = np.concatenate(
+                [np.asarray(buf_x, np.int64)[:, None], neg], axis=1)
+            labels = np.zeros((B, K + 1), np.float32)
+            labels[:, 0] = 1.0
+            syn0, syn1neg, _ = self._neg_step(
+                syn0, syn1neg, center, jnp.asarray(ctx, jnp.int32),
+                jnp.asarray(labels), lr)
+        return syn0, syn1, syn1neg
+
+    # ------------------------------------------------------- query API
+    def get_word_vector(self, word: str) -> Optional[np.ndarray]:
+        i = self.vocab.index_of(word)
+        return None if i < 0 else self.syn0[i]
+
+    def has_word(self, word: str) -> bool:
+        return self.vocab.contains_word(word)
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.get_word_vector(a), self.get_word_vector(b)
+        if va is None or vb is None:
+            return float("nan")
+        denom = np.linalg.norm(va) * np.linalg.norm(vb)
+        return float(va @ vb / denom) if denom else 0.0
+
+    def words_nearest(self, word_or_vec, top_n: int = 10) -> List[str]:
+        if isinstance(word_or_vec, str):
+            v = self.get_word_vector(word_or_vec)
+            exclude = {word_or_vec}
+            if v is None:
+                return []
+        else:
+            v = np.asarray(word_or_vec)
+            exclude = set()
+        norms = np.linalg.norm(self.syn0, axis=1) * np.linalg.norm(v)
+        sims = self.syn0 @ v / np.maximum(norms, 1e-12)
+        order = np.argsort(-sims)
+        out = []
+        for i in order:
+            w = self.vocab.word_at_index(int(i))
+            if w not in exclude:
+                out.append(w)
+            if len(out) >= top_n:
+                break
+        return out
+
+    wordsNearest = words_nearest

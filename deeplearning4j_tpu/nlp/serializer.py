@@ -1,0 +1,101 @@
+"""WordVectorSerializer (parity: models/embeddings/loader/
+WordVectorSerializer.java): Google word2vec-compatible text format +
+a native npz format carrying the full training state."""
+
+from __future__ import annotations
+
+import gzip
+import io
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+from deeplearning4j_tpu.nlp.vocab import AbstractCache
+
+
+class WordVectorSerializer:
+    # ---------------- text (w2v-compatible) ----------------
+    @staticmethod
+    def write_word_vectors(model: SequenceVectors, path):
+        """First line: "<vocab> <dim>", then "word v1 v2 ..." per word."""
+        opener = gzip.open if str(path).endswith(".gz") else open
+        with opener(path, "wt", encoding="utf-8") as f:
+            V, D = model.syn0.shape
+            f.write(f"{V} {D}\n")
+            for i in range(V):
+                word = model.vocab.word_at_index(i)
+                vec = " ".join(f"{v:.6f}" for v in model.syn0[i])
+                f.write(f"{word} {vec}\n")
+
+    writeWordVectors = write_word_vectors
+
+    @staticmethod
+    def read_word_vectors(path) -> SequenceVectors:
+        opener = gzip.open if str(path).endswith(".gz") else open
+        with opener(path, "rt", encoding="utf-8") as f:
+            first = f.readline().split()
+            has_header = len(first) == 2
+            if has_header:
+                V, D = int(first[0]), int(first[1])
+                rows = []
+            else:
+                rows = [first]
+                D = len(first) - 1
+            for line in f:
+                parts = line.rstrip("\n").split(" ")
+                if len(parts) >= 2:
+                    rows.append(parts)
+        model = SequenceVectors(layer_size=D)
+        cache = AbstractCache()
+        vecs = []
+        for r in rows:
+            word = r[0]
+            cache.add_token(word)
+            vecs.append(np.asarray([float(v) for v in r[1:]], np.float32))
+        cache.finalize_vocab()
+        # finalize sorts by count (all 1) then alphabetically; re-map to
+        # preserve file order instead
+        cache._by_index = [cache._words[r[0]] for r in rows]
+        for i, w in enumerate(cache._by_index):
+            w.index = i
+        model.vocab = cache
+        model.syn0 = np.stack(vecs)
+        return model
+
+    loadTxtVectors = read_word_vectors
+
+    # ---------------- native (full state) ----------------
+    @staticmethod
+    def write_full_model(model: SequenceVectors, path):
+        words = "\n".join(model.vocab.words())
+        counts = model.vocab.counts()
+        arrays = {"syn0": model.syn0, "counts": counts,
+                  "words": np.frombuffer(words.encode(), np.uint8)}
+        if model.syn1 is not None:
+            arrays["syn1"] = model.syn1
+        if model.syn1neg is not None:
+            arrays["syn1neg"] = model.syn1neg
+        np.savez_compressed(path, **arrays)
+
+    @staticmethod
+    def read_full_model(path) -> SequenceVectors:
+        with np.load(path) as z:
+            words = bytes(z["words"]).decode().split("\n")
+            counts = z["counts"]
+            syn0 = z["syn0"]
+            syn1 = z["syn1"] if "syn1" in z.files else None
+            syn1neg = z["syn1neg"] if "syn1neg" in z.files else None
+        model = SequenceVectors(layer_size=syn0.shape[1])
+        cache = AbstractCache()
+        for w, c in zip(words, counts):
+            cache.add_token(w, float(c))
+        cache.finalize_vocab()
+        cache._by_index = [cache._words[w] for w in words]
+        for i, vw in enumerate(cache._by_index):
+            vw.index = i
+        model.vocab = cache
+        model.syn0 = syn0
+        model.syn1 = syn1
+        model.syn1neg = syn1neg
+        return model

@@ -1,0 +1,156 @@
+"""NLP tests: vocab/Huffman, tokenization, Word2Vec semantic quality,
+ParagraphVectors, GloVe, serialization, vectorizers (ref:
+deeplearning4j-nlp tests assert similarity rankings on a corpus)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    BagOfWordsVectorizer,
+    CollectionSentenceIterator,
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+    Glove,
+    ParagraphVectors,
+    TfidfVectorizer,
+    Word2Vec,
+    WordVectorSerializer,
+)
+from deeplearning4j_tpu.nlp.sentence_iterator import LabelledDocument
+from deeplearning4j_tpu.nlp.vocab import AbstractCache, build_huffman
+
+
+def _corpus(n=300, seed=5):
+    """Two-topic synthetic corpus: animal words co-occur, tech words
+    co-occur — embeddings must separate the clusters."""
+    rng = np.random.default_rng(seed)
+    animals = ["cat", "dog", "horse", "cow", "sheep", "goat"]
+    tech = ["cpu", "gpu", "ram", "disk", "cache", "bus"]
+    sents = []
+    for _ in range(n):
+        words = rng.choice(animals if rng.random() < 0.5 else tech,
+                           size=8, replace=True)
+        sents.append(" ".join(words))
+    return sents, animals, tech
+
+
+def test_vocab_and_huffman():
+    cache = AbstractCache(min_word_frequency=2)
+    for tok in ("a a a a b b b c c d".split()):
+        cache.add_token(tok)
+    cache.finalize_vocab()
+    assert cache.words() == ["a", "b", "c"]  # d dropped (freq 1)
+    assert cache.index_of("a") == 0
+    max_len = build_huffman(cache)
+    assert max_len >= 1
+    # most frequent word has the shortest code
+    wa = cache.word_for("a")
+    wc = cache.word_for("c")
+    assert len(wa.codes) <= len(wc.codes)
+
+
+def test_tokenizer_preprocessing():
+    tf = DefaultTokenizerFactory()
+    tf.set_token_pre_processor(CommonPreprocessor())
+    toks = tf.create("Hello, World! 123 foo-bar").get_tokens()
+    assert "hello" in toks and "world" in toks
+    assert all("!" not in t and "," not in t for t in toks)
+
+
+@pytest.mark.parametrize("mode", ["negative", "hs"])
+def test_word2vec_semantic_clusters(mode):
+    sents, animals, tech = _corpus()
+    w2v = (Word2Vec.Builder()
+           .layer_size(24).window_size(4)
+           .negative_sample(5 if mode == "negative" else 0)
+           .use_hierarchic_softmax(mode == "hs")
+           .min_word_frequency(1).epochs(3).batch_size(256).seed(1)
+           .iterate(CollectionSentenceIterator(sents))
+           .build())
+    w2v.fit()
+    assert w2v.has_word("cat") and w2v.has_word("cpu")
+    # intra-cluster similarity dominates inter-cluster
+    intra = np.mean([w2v.similarity("cat", "dog"),
+                     w2v.similarity("cpu", "gpu")])
+    inter = np.mean([w2v.similarity("cat", "cpu"),
+                     w2v.similarity("dog", "ram")])
+    assert intra > inter + 0.2, (intra, inter)
+    # nearest neighbors of an animal are animals
+    near = w2v.words_nearest("horse", top_n=3)
+    assert sum(w in animals for w in near) >= 2, near
+
+
+def test_word2vec_serialization_round_trip(tmp_path):
+    sents, _, _ = _corpus(n=50)
+    w2v = (Word2Vec.Builder().layer_size(8).epochs(1).seed(2)
+           .iterate(CollectionSentenceIterator(sents)).build())
+    w2v.fit()
+    p = tmp_path / "vecs.txt"
+    WordVectorSerializer.write_word_vectors(w2v, p)
+    loaded = WordVectorSerializer.read_word_vectors(p)
+    assert loaded.vocab.num_words() == w2v.vocab.num_words()
+    np.testing.assert_allclose(loaded.get_word_vector("cat"),
+                               w2v.get_word_vector("cat"), atol=1e-5)
+    # native full-model round trip
+    p2 = tmp_path / "model.npz"
+    WordVectorSerializer.write_full_model(w2v, p2)
+    full = WordVectorSerializer.read_full_model(p2)
+    np.testing.assert_array_equal(full.syn0, w2v.syn0)
+    assert full.vocab.word_at_index(0) == w2v.vocab.word_at_index(0)
+
+
+def test_paragraph_vectors_dbow_separates_topics():
+    sents, _, _ = _corpus(n=80)
+    docs = [LabelledDocument(s, [f"DOC_{i}"]) for i, s in enumerate(sents)]
+    pv = (ParagraphVectors.Builder()
+          .layer_size(16).negative_sample(5).epochs(5).seed(3)
+          .iterate(docs).build())
+    pv.fit()
+    # doc vectors of same-topic docs should be closer than cross-topic
+    def topic(s):
+        return "animal" if "cat" in s or "dog" in s or "horse" in s \
+            or "cow" in s or "sheep" in s or "goat" in s else "tech"
+    sims_intra, sims_inter = [], []
+    for i in range(0, 40):
+        for j in range(i + 1, 40):
+            s = pv.similarity_doc(f"DOC_{i}", f"DOC_{j}")
+            (sims_intra if topic(sents[i]) == topic(sents[j])
+             else sims_inter).append(s)
+    assert np.mean(sims_intra) > np.mean(sims_inter), (
+        np.mean(sims_intra), np.mean(sims_inter))
+
+
+def test_paragraph_vectors_infer(tmp_path):
+    sents, _, _ = _corpus(n=60)
+    docs = [LabelledDocument(s, [f"DOC_{i}"]) for i, s in enumerate(sents)]
+    pv = (ParagraphVectors.Builder()
+          .layer_size(12).negative_sample(5).epochs(3).seed(4)
+          .iterate(docs).build())
+    pv.fit()
+    v = pv.infer_vector("cat dog horse cow")
+    assert v.shape == (12,) and np.any(v != 0)
+
+
+def test_glove_clusters():
+    sents, animals, tech = _corpus(n=200)
+    seqs = [s.split() for s in sents]
+    glove = Glove(layer_size=16, window=4, epochs=20, batch_size=128,
+                  learning_rate=0.1, seed=5)
+    glove.fit(seqs)
+    intra = glove.similarity("cat", "dog")
+    inter = glove.similarity("cat", "cpu")
+    assert intra > inter, (intra, inter)
+
+
+def test_bow_tfidf():
+    docs = ["the cat sat", "the dog sat", "cpu and gpu"]
+    bow = BagOfWordsVectorizer()
+    m = bow.fit_transform(docs)
+    assert m.shape[0] == 3
+    i_the = bow.vocab.index_of("the")
+    assert m[0, i_the] == 1.0 and m[2, i_the] == 0.0
+    tfidf = TfidfVectorizer()
+    t = tfidf.fit_transform(docs)
+    # 'the' (2 docs) weighted below 'cpu' (1 doc) within doc 2
+    i_cpu = tfidf.vocab.index_of("cpu")
+    assert t[2, i_cpu] > t[0, tfidf.vocab.index_of("the")]
