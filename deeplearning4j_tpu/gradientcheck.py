@@ -35,10 +35,33 @@ def check_gradients(net, x, y, fmask=None, lmask=None,
         raise ValueError(
             "gradient checks need a float64 network "
             "(MultiLayerNetwork(conf, dtype=jnp.float64) under enable_x64)")
-    x = jnp.asarray(x, jnp.float64)
-    y = jnp.asarray(y, jnp.float64)
-    fm = None if fmask is None else jnp.asarray(fmask, jnp.float64)
-    lm = None if lmask is None else jnp.asarray(lmask, jnp.float64)
+    is_graph = hasattr(net.conf, "network_inputs")
+    if is_graph:
+        # ComputationGraph path (GradientCheckUtil.java:238): inputs are a
+        # {name: array} dict, labels/masks are per-output lists.
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        ys = y if isinstance(y, (list, tuple)) else [y]
+        names = net.conf.network_inputs
+        if len(xs) != len(names):
+            raise ValueError(
+                f"graph has {len(names)} inputs {names}, got {len(xs)} arrays")
+        x = {name: jnp.asarray(a, jnp.float64)
+             for name, a in zip(names, xs)}
+        y = [jnp.asarray(a, jnp.float64) for a in ys]
+        fm = None if fmask is None else {
+            name: jnp.asarray(m, jnp.float64)
+            for name, m in zip(net.conf.network_inputs,
+                               fmask if isinstance(fmask, (list, tuple))
+                               else [fmask])}
+        lm = None if lmask is None else [
+            jnp.asarray(m, jnp.float64)
+            for m in (lmask if isinstance(lmask, (list, tuple))
+                      else [lmask])]
+    else:
+        x = jnp.asarray(x, jnp.float64)
+        y = jnp.asarray(y, jnp.float64)
+        fm = None if fmask is None else jnp.asarray(fmask, jnp.float64)
+        lm = None if lmask is None else jnp.asarray(lmask, jnp.float64)
     rng = jax.random.PRNGKey(seed)
 
     def loss(params):

@@ -23,10 +23,28 @@ def _sigmoid(x):
     return 1.0 / (1.0 + np.exp(-x))
 
 
-class _NegSamplingStep:
-    """jit'd skip-gram negative-sampling update."""
+def _chunk_of(batch: int, chunk: int) -> int:
+    """Largest divisor of `batch` that is <= chunk (scan needs equal splits)."""
+    c = min(chunk, batch)
+    while batch % c:
+        c -= 1
+    return max(c, 1)
 
-    def __init__(self):
+
+class _NegSamplingStep:
+    """jit'd skip-gram negative-sampling update.
+
+    The reference applies per-pair SGD updates one at a time
+    (SkipGram.java:258-272). Summing a whole large batch of updates
+    computed at the same stale table values multiplies the effective lr
+    for in-batch duplicate rows and collapses embeddings on small vocabs.
+    We approximate the sequential semantics with `lax.scan` over fixed
+    sub-batches: updates inside a chunk are batched einsums (MXU), chunks
+    see each other's fresh values.
+    """
+
+    def __init__(self, chunk: int = 32):
+        self.chunk = chunk
         self._fn = None
 
     def __call__(self, syn0, syn1neg, center, ctx, labels, lr):
@@ -34,43 +52,50 @@ class _NegSamplingStep:
         import jax.numpy as jnp
 
         if self._fn is None:
+            chunk = self.chunk
+
             def step(syn0, syn1neg, center, ctx, labels, lr):
-                v = syn0[center]                       # [B,D]
-                u = syn1neg[ctx]                       # [B,K,D]
-                logits = jnp.einsum("bd,bkd->bk", v, u)
-                p = jax.nn.sigmoid(logits)
-                g = (labels - p) * lr                  # [B,K]
-                dv = jnp.einsum("bk,bkd->bd", g, u)
-                du = jnp.einsum("bk,bd->bkd", g, v)
-                # scale each row's summed update by 1/sqrt(batch count):
-                # raw sums computed at the same old value multiply the
-                # effective lr by the row's batch frequency and collapse
-                # embeddings for small vocabs (hogwild applies updates
-                # sequentially); full 1/count under-trains frequent words
-                # — sqrt is the measured sweet spot
-                c_cnt = jnp.zeros(syn0.shape[0]).at[center].add(1.0)
-                dv = dv / jnp.sqrt(c_cnt[center])[:, None]
-                flat_ctx = ctx.reshape(-1)
-                x_cnt = jnp.zeros(syn1neg.shape[0]).at[flat_ctx].add(1.0)
-                du = (du.reshape(-1, du.shape[-1])
-                      / jnp.sqrt(x_cnt[flat_ctx])[:, None])
-                syn0 = syn0.at[center].add(dv)
-                syn1neg = syn1neg.at[flat_ctx].add(du)
-                # logistic loss for reporting
-                eps = 1e-7
-                loss = -jnp.mean(
-                    labels * jnp.log(p + eps)
-                    + (1 - labels) * jnp.log(1 - p + eps))
-                return syn0, syn1neg, loss
+                B, K1 = ctx.shape
+                c = _chunk_of(B, chunk)
+                S = B // c
+
+                def body(carry, xs):
+                    syn0, syn1neg = carry
+                    cen, cx, lab = xs
+                    v = syn0[cen]                       # [c,D]
+                    u = syn1neg[cx]                     # [c,K+1,D]
+                    logits = jnp.einsum("bd,bkd->bk", v, u)
+                    p = jax.nn.sigmoid(logits)
+                    g = (lab - p) * lr                  # [c,K+1]
+                    dv = jnp.einsum("bk,bkd->bd", g, u)
+                    du = jnp.einsum("bk,bd->bkd", g, v)
+                    syn0 = syn0.at[cen].add(dv)
+                    syn1neg = syn1neg.at[cx.reshape(-1)].add(
+                        du.reshape(-1, du.shape[-1]))
+                    eps = 1e-7
+                    loss = -jnp.mean(
+                        lab * jnp.log(p + eps)
+                        + (1 - lab) * jnp.log(1 - p + eps))
+                    return (syn0, syn1neg), loss
+
+                (syn0, syn1neg), losses = jax.lax.scan(
+                    body, (syn0, syn1neg),
+                    (center.reshape(S, c), ctx.reshape(S, c, K1),
+                     labels.reshape(S, c, K1)))
+                return syn0, syn1neg, jnp.mean(losses)
 
             self._fn = jax.jit(step, donate_argnums=(0, 1))
         return self._fn(syn0, syn1neg, center, ctx, labels, lr)
 
 
 class _HierarchicSoftmaxStep:
-    """jit'd skip-gram hierarchical-softmax update (SkipGram.java:238)."""
+    """jit'd skip-gram hierarchical-softmax update (SkipGram.java:238).
 
-    def __init__(self):
+    Same scan-over-sub-batches sequential semantics as _NegSamplingStep.
+    """
+
+    def __init__(self, chunk: int = 32):
+        self.chunk = chunk
         self._fn = None
 
     def __call__(self, syn0, syn1, center, points, codes, mask, lr):
@@ -78,30 +103,40 @@ class _HierarchicSoftmaxStep:
         import jax.numpy as jnp
 
         if self._fn is None:
+            chunk = self.chunk
+
             def step(syn0, syn1, center, points, codes, mask, lr):
-                v = syn0[center]                       # [B,D]
-                u = syn1[points]                       # [B,L,D]
-                logits = jnp.einsum("bd,bld->bl", v, u)
-                p = jax.nn.sigmoid(logits)
-                # target: 1 - code
-                g = ((1.0 - codes) - p) * mask * lr
-                dv = jnp.einsum("bl,bld->bd", g, u)
-                du = jnp.einsum("bl,bd->bld", g, v)
-                # per-row 1/sqrt(count) scaling over in-batch duplicates (see neg-sampling)
-                c_cnt = jnp.zeros(syn0.shape[0]).at[center].add(1.0)
-                dv = dv / jnp.sqrt(c_cnt[center])[:, None]
-                flat_pts = points.reshape(-1)
-                flat_msk = mask.reshape(-1)
-                p_cnt = jnp.zeros(syn1.shape[0]).at[flat_pts].add(flat_msk)
-                du = (du.reshape(-1, du.shape[-1])
-                      / jnp.sqrt(jnp.maximum(p_cnt, 1.0))[flat_pts][:, None])
-                syn0 = syn0.at[center].add(dv)
-                syn1 = syn1.at[flat_pts].add(du)
-                eps = 1e-7
-                tgt = 1.0 - codes
-                ll = tgt * jnp.log(p + eps) + (1 - tgt) * jnp.log(1 - p + eps)
-                loss = -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-                return syn0, syn1, loss
+                B, L = points.shape
+                c = _chunk_of(B, chunk)
+                S = B // c
+
+                def body(carry, xs):
+                    syn0, syn1 = carry
+                    cen, pts, cds, msk = xs
+                    v = syn0[cen]                       # [c,D]
+                    u = syn1[pts]                       # [c,L,D]
+                    logits = jnp.einsum("bd,bld->bl", v, u)
+                    p = jax.nn.sigmoid(logits)
+                    # target: 1 - code
+                    g = ((1.0 - cds) - p) * msk * lr
+                    dv = jnp.einsum("bl,bld->bd", g, u)
+                    du = jnp.einsum("bl,bd->bld", g, v)
+                    syn0 = syn0.at[cen].add(dv)
+                    syn1 = syn1.at[pts.reshape(-1)].add(
+                        du.reshape(-1, du.shape[-1]))
+                    eps = 1e-7
+                    tgt = 1.0 - cds
+                    ll = (tgt * jnp.log(p + eps)
+                          + (1 - tgt) * jnp.log(1 - p + eps))
+                    loss = (-jnp.sum(ll * msk)
+                            / jnp.maximum(jnp.sum(msk), 1.0))
+                    return (syn0, syn1), loss
+
+                (syn0, syn1), losses = jax.lax.scan(
+                    body, (syn0, syn1),
+                    (center.reshape(S, c), points.reshape(S, c, L),
+                     codes.reshape(S, c, L), mask.reshape(S, c, L)))
+                return syn0, syn1, jnp.mean(losses)
 
             self._fn = jax.jit(step, donate_argnums=(0, 1))
         return self._fn(syn0, syn1, center, points, codes, mask, lr)
@@ -239,10 +274,12 @@ class SequenceVectors:
         import jax.numpy as jnp
 
         # pad the final ragged batch to the fixed batch size so the jit
-        # step compiles exactly once (padding rows use index 0 with lr
-        # masked via duplicate-safe zero labels trick: simpler — replicate
-        # last pair; the few duplicated updates are negligible)
-        B = self.batch_size
+        # step compiles exactly once (padding replicates the last pair;
+        # the few duplicated updates are negligible). Pad up to a multiple
+        # of the scan chunk so _chunk_of never degrades to tiny chunks for
+        # prime batch sizes.
+        chunk = self._neg_step.chunk
+        B = -(-self.batch_size // chunk) * chunk
         if len(buf_c) < B:
             reps = B - len(buf_c)
             buf_c = buf_c + [buf_c[-1]] * reps
@@ -267,9 +304,18 @@ class SequenceVectors:
         if self.negative > 0:
             K = self.negative
             V = self.vocab.num_words()
+            pos = np.asarray(buf_x, np.int64)[:, None]
             neg = rng.choice(V, size=(B, K), p=self._unigram)
-            ctx = np.concatenate(
-                [np.asarray(buf_x, np.int64)[:, None], neg], axis=1)
+            # resample negatives colliding with the row's positive target —
+            # the reference resamples on collision (SkipGram.java:258); a
+            # collision would label the same index 1 and 0 in one update
+            for _ in range(16):
+                coll = neg == pos
+                n_coll = int(coll.sum())
+                if not n_coll:
+                    break
+                neg[coll] = rng.choice(V, size=n_coll, p=self._unigram)
+            ctx = np.concatenate([pos, neg], axis=1)
             labels = np.zeros((B, K + 1), np.float32)
             labels[:, 0] = 1.0
             syn0, syn1neg, _ = self._neg_step(

@@ -14,6 +14,7 @@ Used two ways, like the reference:
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence
 
@@ -25,7 +26,9 @@ from deeplearning4j_tpu.nn.conf.inputs import InputType, InputTypeFeedForward
 from deeplearning4j_tpu.nn.layers.base import BaseLayer
 from deeplearning4j_tpu.nn.weights import init_weights
 
-_HALF_LOG_2PI = 0.5 * jnp.log(2.0 * jnp.pi)
+# math.log, not jnp.log: module constants must never trigger device/backend
+# initialization at import time (breaks CPU-platform selection in dryruns).
+_HALF_LOG_2PI = 0.5 * math.log(2.0 * math.pi)
 
 
 def _mlp_init(key, sizes, weight_init, dtype):

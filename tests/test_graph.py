@@ -314,3 +314,24 @@ def test_input_name_collision_rejected():
          .set_outputs("out")
          .set_input_types(a=InputType.feed_forward(3))
          .build())
+
+
+def test_graph_gradient_check_multi_input(rng):
+    """check_gradients on a ComputationGraph — the GradientCheckUtil.java:238
+    path: dict inputs, list labels."""
+    with jax.enable_x64(True):
+        conf = (_builder()
+                .add_inputs("a", "b")
+                .add_layer("da", DenseLayer(n_out=4), "a")
+                .add_layer("db", DenseLayer(n_out=4), "b")
+                .add_vertex("m", MergeVertex(), "da", "db")
+                .add_layer("out", OutputLayer(n_out=3, loss="mcxent"), "m")
+                .set_outputs("out")
+                .set_input_types(a=InputType.feed_forward(3),
+                                 b=InputType.feed_forward(2))
+                .build())
+        g = ComputationGraph(conf, dtype=jnp.float64).init()
+        xa = rng.normal(size=(4, 3))
+        xb = rng.normal(size=(4, 2))
+        y = np.eye(3)[rng.integers(0, 3, 4)]
+        assert check_gradients(g, [xa, xb], [y], subset=20)
