@@ -7,6 +7,7 @@ from deeplearning4j_tpu.datasets.iterators import (  # noqa: F401
     AsyncDataSetIterator,
     BenchmarkDataSetIterator,
     DataSetIterator,
+    DevicePrefetchIterator,
     EarlyTerminationDataSetIterator,
     ListDataSetIterator,
     MultipleEpochsIterator,

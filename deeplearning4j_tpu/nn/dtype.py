@@ -1,0 +1,52 @@
+"""Mixed-precision policy helpers — the TPU analogue of the reference's
+cuDNN fp16 data-type mapping (BaseCudnnHelper dtype handling).
+
+Policy (standard bf16 mixed precision):
+- master params + optimizer state stay float32;
+- forward/backward compute runs in bfloat16 (matmuls/convs hit the MXU at
+  2x the fp32 rate, activations take half the HBM bandwidth);
+- loss pre-activations are upcast to float32 (losses.py) so softmax/log
+  stay accurate;
+- BatchNorm statistics are computed/accumulated in float32 (norm.py);
+- gradients arrive back in float32 through the cast's transpose, so the
+  updater math is exact.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def canonical_dtype(dtype):
+    """Accept 'bfloat16'/'float32'/... strings or jnp dtypes."""
+    if dtype is None:
+        return None
+    return jnp.dtype(dtype) if isinstance(dtype, str) else jnp.dtype(dtype)
+
+
+def is_low_precision(dtype) -> bool:
+    return (jnp.issubdtype(dtype, jnp.floating)
+            and jnp.finfo(dtype).bits < 32)
+
+
+def cast_floating(tree, dtype):
+    """Cast every floating leaf of a pytree to `dtype` (ints untouched)."""
+    if dtype is None:
+        return tree
+
+    def _cast(a):
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating):
+            return a.astype(dtype)
+        return a
+
+    return jax.tree_util.tree_map(_cast, tree)
+
+
+def ensure_f32(a):
+    """Upcast bf16/f16 arrays to f32; leave f32/f64 untouched (so float64
+    gradient checks keep full precision)."""
+    if (hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+            and jnp.finfo(a.dtype).bits < 32):
+        return a.astype(jnp.float32)
+    return a

@@ -54,11 +54,16 @@ def _as_multi(data) -> Tuple[List, List, Optional[List], Optional[List]]:
 
 class ComputationGraph:
     def __init__(self, conf: ComputationGraphConfiguration,
-                 dtype=jnp.float32):
+                 dtype=jnp.float32, compute_dtype=None):
+        """`dtype` = parameter/optimizer dtype; `compute_dtype` (e.g.
+        jnp.bfloat16) runs forward+backward in that dtype with fp32 master
+        params — the TPU mixed-precision policy (see nn/dtype.py)."""
         if not conf.nodes:
             raise ValueError("Configuration has no nodes")
+        from deeplearning4j_tpu.nn.dtype import canonical_dtype
         self.conf = conf
         self.dtype = dtype
+        self.compute_dtype = canonical_dtype(compute_dtype)
         self.topo: List[GraphNode] = conf.topological_order()
         self.node_types = None
         self._layer_in_types = None
@@ -230,12 +235,30 @@ class ComputationGraph:
             for n in self.topo if n.kind == "layer"
         }
 
+        cd = self.compute_dtype
+
+        def loss_for_grad(params, states, inputs, labels, rng, fmasks,
+                          lmasks, carries):
+            if cd is not None:
+                from deeplearning4j_tpu.nn.dtype import cast_floating
+                params = cast_floating(params, cd)
+                inputs = cast_floating(inputs, cd)
+                carries = cast_floating(carries, cd)
+            loss, (new_states, new_carries) = self._loss_fn(
+                params, states, inputs, labels, rng, fmasks, lmasks,
+                rnn_carries=carries)
+            if cd is not None:
+                from deeplearning4j_tpu.nn.dtype import cast_floating
+                new_carries = cast_floating(new_carries, self.dtype)
+                loss = loss.astype(self.dtype)
+            return loss, (new_states, new_carries)
+
         def step_fn(params, upd_states, states, step, inputs, labels,
                     fmasks, lmasks, rng, carries):
             (loss, (new_states, new_carries)), grads = jax.value_and_grad(
-                self._loss_fn, has_aux=True)(
+                loss_for_grad, has_aux=True)(
                     params, states, inputs, labels, rng, fmasks, lmasks,
-                    rnn_carries=carries if with_carries else None)
+                    carries if with_carries else None)
             grads = self._clip_grads(grads)
             lr = schedule_lr(conf, step)
             frozen = {n.name for n in self.topo
@@ -368,10 +391,17 @@ class ComputationGraph:
         inputs = {name: jnp.asarray(x, self.dtype)
                   for name, x in zip(conf.network_inputs, xs)}
         if "predict" not in self._jit_cache:
+            cd = self.compute_dtype
+
             def predict_fn(params, states, inputs):
+                if cd is not None:
+                    from deeplearning4j_tpu.nn.dtype import cast_floating
+                    params = cast_floating(params, cd)
+                    inputs = cast_floating(inputs, cd)
                 acts, _, _ = self._forward(params, states, inputs,
                                            train=False, rng=None)
-                return [acts[n] for n in self.conf.network_outputs]
+                return [acts[n].astype(self.dtype) if cd is not None
+                        else acts[n] for n in self.conf.network_outputs]
             self._jit_cache["predict"] = jax.jit(predict_fn)
         outs = self._jit_cache["predict"](self.params, self.states, inputs)
         return outs[0] if len(outs) == 1 else outs

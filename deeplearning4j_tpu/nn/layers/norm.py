@@ -67,10 +67,20 @@ class BatchNormalization(Layer):
         return {"mean": jnp.zeros((c,), dtype), "var": jnp.ones((c,), dtype)}
 
     def apply(self, params, x, *, train=False, rng=None, state=None, mask=None):
+        from deeplearning4j_tpu.nn.dtype import is_low_precision
+
+        # Mixed-precision policy: per-channel statistics accumulate in f32
+        # (bf16 variance/EMA drifts), but activations stay in the compute
+        # dtype end-to-end — the normalization is folded into one per-
+        # element multiply-add (x*scale + shift) with [C]-sized f32
+        # scale/shift cast down, so BN adds no f32 HBM traffic and fuses
+        # with neighboring ops.
+        in_dtype = x.dtype
+        stat_dtype = jnp.float32 if is_low_precision(in_dtype) else in_dtype
         axes = tuple(range(x.ndim - 1))
         if train:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            mean = jnp.mean(x, axis=axes, dtype=stat_dtype)
+            var = jnp.var(x.astype(stat_dtype), axis=axes)
             new_state = None
             if state is not None:
                 d = self.decay
@@ -82,13 +92,17 @@ class BatchNormalization(Layer):
             if state is not None:
                 mean, var = state["mean"], state["var"]
             else:
-                mean = jnp.mean(x, axis=axes)
-                var = jnp.var(x, axis=axes)
+                mean = jnp.mean(x, axis=axes, dtype=stat_dtype)
+                var = jnp.var(x.astype(stat_dtype), axis=axes)
             new_state = state
 
-        y = (x - mean) * lax.rsqrt(var + self.eps)
+        scale = lax.rsqrt(var + self.eps)
         if not self.lock_gamma_beta and params:
-            y = y * params["gamma"] + params["beta"]
+            scale = scale * params["gamma"].astype(stat_dtype)
+            shift = params["beta"].astype(stat_dtype) - mean * scale
         elif self.lock_gamma_beta:
-            y = y * self.gamma + self.beta
-        return y, new_state
+            scale = scale * self.gamma
+            shift = self.beta - mean * scale
+        else:
+            shift = -mean * scale
+        return x * scale.astype(in_dtype) + shift.astype(in_dtype), new_state

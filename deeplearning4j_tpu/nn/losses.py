@@ -170,6 +170,18 @@ LOSSES = {
 }
 
 
+def _f32_loss(fn):
+    """Loss math runs in at least float32: under the bf16 mixed-precision
+    policy the output head's matmul stays bf16 but softmax/log/exp here
+    would lose too much precision. float64 passes through untouched
+    (gradient checks)."""
+    def wrapped(labels, pre_output, *args, **kwargs):
+        from deeplearning4j_tpu.nn.dtype import ensure_f32
+        return fn(ensure_f32(labels), ensure_f32(pre_output), *args, **kwargs)
+    wrapped.__name__ = getattr(fn, "__name__", "loss")
+    return wrapped
+
+
 def get_loss(name):
     """Resolve a loss by name (case-insensitive) or pass callables through."""
     if callable(name):
@@ -177,4 +189,4 @@ def get_loss(name):
     key = str(name).lower()
     if key not in LOSSES:
         raise ValueError(f"Unknown loss '{name}'. Known: {sorted(LOSSES)}")
-    return LOSSES[key]
+    return _f32_loss(LOSSES[key])

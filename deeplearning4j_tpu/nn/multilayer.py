@@ -52,11 +52,18 @@ def _as_batch(data) -> Tuple:
 
 
 class MultiLayerNetwork:
-    def __init__(self, conf: MultiLayerConfiguration, dtype=jnp.float32):
+    def __init__(self, conf: MultiLayerConfiguration, dtype=jnp.float32,
+                 compute_dtype=None):
+        """`dtype` is the parameter/optimizer-state dtype; `compute_dtype`
+        (e.g. jnp.bfloat16 or "bfloat16") runs forward+backward compute in
+        that dtype while keeping fp32 master params — the standard TPU
+        mixed-precision policy (see nn/dtype.py)."""
         if not conf.layers:
             raise ValueError("Configuration has no layers")
+        from deeplearning4j_tpu.nn.dtype import canonical_dtype
         self.conf = conf
         self.dtype = dtype
+        self.compute_dtype = canonical_dtype(compute_dtype)
         self.layer_input_types: Optional[List] = None
         if conf.input_type is not None:
             self.layer_input_types = conf.resolve_shapes()
@@ -248,12 +255,32 @@ class MultiLayerNetwork:
             for l in conf.layers
         ]
 
+        cd = self.compute_dtype
+
+        def loss_for_grad(params, states, x, y, rng, fmask, lmask, carries):
+            if cd is not None:
+                from deeplearning4j_tpu.nn.dtype import cast_floating
+                # params/inputs/carries compute in bf16; states (BN running
+                # stats) stay fp32 — norm.py handles the mixing; the cast's
+                # transpose returns fp32 grads for the fp32 master params.
+                params = cast_floating(params, cd)
+                x = cast_floating(x, cd)
+                carries = cast_floating(carries, cd)
+            loss, (new_states, new_carries) = self._loss_fn(
+                params, states, x, y, rng, fmask, lmask,
+                rnn_carries=carries)
+            if cd is not None:
+                from deeplearning4j_tpu.nn.dtype import cast_floating
+                new_carries = cast_floating(new_carries, self.dtype)
+                loss = loss.astype(self.dtype)
+            return loss, (new_states, new_carries)
+
         def step_fn(params, upd_states, states, step, x, y, fmask, lmask,
                     rng, carries):
             (loss, (new_states, new_carries)), grads = jax.value_and_grad(
-                self._loss_fn, has_aux=True)(
+                loss_for_grad, has_aux=True)(
                     params, states, x, y, rng, fmask, lmask,
-                    rnn_carries=carries if with_carries else None)
+                    carries if with_carries else None)
             grads = self._clip_grads(grads)
             lr = schedule_lr(conf, step)
             new_params = []
@@ -376,10 +403,16 @@ class MultiLayerNetwork:
         """Full forward pass (ref: MLN.output():761-864)."""
         x = jnp.asarray(x, self.dtype)
         if "predict" not in self._jit_cache:
+            cd = self.compute_dtype
+
             def predict_fn(params, states, x):
+                if cd is not None:
+                    from deeplearning4j_tpu.nn.dtype import cast_floating
+                    params = cast_floating(params, cd)
+                    x = cast_floating(x, cd)
                 out, _, _ = self._forward(params, states, x,
                                           train=False, rng=None)
-                return out
+                return out.astype(self.dtype) if cd is not None else out
             self._jit_cache["predict"] = jax.jit(predict_fn)
         return self._jit_cache["predict"](self.params, self.states, x)
 

@@ -38,7 +38,7 @@ class ZooModel:
     def __init__(self, num_classes: Optional[int] = None,
                  input_shape: Optional[Sequence[int]] = None,
                  seed: int = 123, updater: str = "nesterovs",
-                 learning_rate: float = 1e-2):
+                 learning_rate: float = 1e-2, compute_dtype=None):
         if num_classes is not None:
             self.num_classes = num_classes
         if input_shape is not None:
@@ -46,6 +46,7 @@ class ZooModel:
         self.seed = seed
         self.updater = updater
         self.learning_rate = learning_rate
+        self.compute_dtype = compute_dtype   # e.g. "bfloat16" for MXU speed
 
     def conf(self):
         raise NotImplementedError
@@ -59,8 +60,8 @@ class ZooModel:
 
         c = self.conf()
         if isinstance(c, ComputationGraphConfiguration):
-            return ComputationGraph(c).init()
-        return MultiLayerNetwork(c).init()
+            return ComputationGraph(c, compute_dtype=self.compute_dtype).init()
+        return MultiLayerNetwork(c, compute_dtype=self.compute_dtype).init()
 
     # -------- pretrained (file-based; no egress) --------
     def pretrained_available(self) -> bool:
