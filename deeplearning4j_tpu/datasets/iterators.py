@@ -290,6 +290,11 @@ class DevicePrefetchIterator(DataSetIterator):
                 break
         return self
 
+    def has_next(self):
+        if self._staged is None:
+            self.__iter__()
+        return bool(self._staged)
+
     def __next__(self):
         if self._staged is None:
             self.__iter__()

@@ -159,7 +159,6 @@ class SequenceVectors:
         self.learning_rate = learning_rate
         self.min_learning_rate = min_learning_rate
         self.epochs = epochs
-        self.batch_size = batch_size
         self.sampling = sampling
         self.use_cbow = use_cbow
         self.seed = seed
@@ -170,8 +169,14 @@ class SequenceVectors:
         self.syn1neg: Optional[np.ndarray] = None
         self._unigram: Optional[np.ndarray] = None
         self._max_code_len = 0
-        self._neg_step = _NegSamplingStep()
-        self._hs_step = _HierarchicSoftmaxStep()
+        # one chunk constant shared by both jit steps; batch_size is
+        # rounded up to a chunk multiple so full batches never need
+        # padding (padding replicates pairs -> over-trains them) and
+        # _chunk_of never degrades for prime batch sizes.
+        self._chunk = 32
+        self.batch_size = -(-batch_size // self._chunk) * self._chunk
+        self._neg_step = _NegSamplingStep(chunk=self._chunk)
+        self._hs_step = _HierarchicSoftmaxStep(chunk=self._chunk)
 
     # ------------------------------------------------------------- vocab
     def build_vocab(self, sequences: Iterable[Sequence[str]]):
@@ -275,11 +280,9 @@ class SequenceVectors:
 
         # pad the final ragged batch to the fixed batch size so the jit
         # step compiles exactly once (padding replicates the last pair;
-        # the few duplicated updates are negligible). Pad up to a multiple
-        # of the scan chunk so _chunk_of never degrades to tiny chunks for
-        # prime batch sizes.
-        chunk = self._neg_step.chunk
-        B = -(-self.batch_size // chunk) * chunk
+        # the few duplicated updates there are negligible). batch_size is
+        # already a chunk multiple (__init__), so full batches need none.
+        B = self.batch_size
         if len(buf_c) < B:
             reps = B - len(buf_c)
             buf_c = buf_c + [buf_c[-1]] * reps

@@ -81,6 +81,8 @@ class ComputationGraph:
         self._rng = None
         self._jit_cache: Dict[str, Any] = {}
         self._updaters: Optional[Dict[str, Any]] = None
+        self._lr_score_factor = 1.0   # lr_policy="score" decay state
+        self._best_score = None
 
     # ------------------------------------------------------------------ init
     def init(self, seed: Optional[int] = None) -> "ComputationGraph":
@@ -254,13 +256,13 @@ class ComputationGraph:
             return loss, (new_states, new_carries)
 
         def step_fn(params, upd_states, states, step, inputs, labels,
-                    fmasks, lmasks, rng, carries):
+                    fmasks, lmasks, rng, carries, lr_scale):
             (loss, (new_states, new_carries)), grads = jax.value_and_grad(
                 loss_for_grad, has_aux=True)(
                     params, states, inputs, labels, rng, fmasks, lmasks,
                     carries if with_carries else None)
             grads = self._clip_grads(grads)
-            lr = schedule_lr(conf, step)
+            lr = schedule_lr(conf, step) * lr_scale
             frozen = {n.name for n in self.topo
                       if n.kind == "layer" and n.obj.frozen}
             new_params = {}
@@ -293,10 +295,16 @@ class ComputationGraph:
          loss) = self._jit_cache[key](
             self.params, self.updater_states, self.states,
             jnp.asarray(self.iteration, jnp.int32), inputs, labels,
-            fmasks, lmasks, sub, carries)
+            fmasks, lmasks, sub, carries,
+            jnp.asarray(self._lr_score_factor, jnp.float32))
         self.iteration += 1
         self._score = loss
+        self._apply_score_decay(loss)
         return loss, new_carries
+
+    def _apply_score_decay(self, loss):
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        MultiLayerNetwork._apply_score_decay(self, loss)
 
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, epochs: int = 1):

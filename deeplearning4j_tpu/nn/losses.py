@@ -183,9 +183,11 @@ def _f32_loss(fn):
 
 
 def get_loss(name):
-    """Resolve a loss by name (case-insensitive) or pass callables through."""
+    """Resolve a loss by name (case-insensitive) or accept a callable.
+    Callables get the same float32 upcast as named losses so custom losses
+    behave consistently under the bf16 mixed-precision policy."""
     if callable(name):
-        return name
+        return _f32_loss(name)
     key = str(name).lower()
     if key not in LOSSES:
         raise ValueError(f"Unknown loss '{name}'. Known: {sorted(LOSSES)}")

@@ -78,6 +78,8 @@ class MultiLayerNetwork:
         self._rng = None
         self._jit_cache: Dict[str, Any] = {}
         self._updaters = None
+        self._lr_score_factor = 1.0   # lr_policy="score" decay state
+        self._best_score = None
 
     # ------------------------------------------------------------------ init
     def init(self, seed: Optional[int] = None) -> "MultiLayerNetwork":
@@ -276,13 +278,13 @@ class MultiLayerNetwork:
             return loss, (new_states, new_carries)
 
         def step_fn(params, upd_states, states, step, x, y, fmask, lmask,
-                    rng, carries):
+                    rng, carries, lr_scale):
             (loss, (new_states, new_carries)), grads = jax.value_and_grad(
                 loss_for_grad, has_aux=True)(
                     params, states, x, y, rng, fmask, lmask,
                     carries if with_carries else None)
             grads = self._clip_grads(grads)
-            lr = schedule_lr(conf, step)
+            lr = schedule_lr(conf, step) * lr_scale
             new_params = []
             new_upd = []
             for i in range(len(params)):
@@ -313,10 +315,26 @@ class MultiLayerNetwork:
          loss) = self._jit_cache[key](
             self.params, self.updater_states, self.states,
             jnp.asarray(self.iteration, jnp.int32), x, y, fmask, lmask,
-            sub, carries)
+            sub, carries, jnp.asarray(self._lr_score_factor, jnp.float32))
         self.iteration += 1
         self._score = loss
+        self._apply_score_decay(loss)
         return loss, new_carries
+
+    def _apply_score_decay(self, loss):
+        """lr_policy='score' (ref: LearningRatePolicy.Score, applied in
+        BaseOptimizer): multiply lr by decay_rate whenever the score fails
+        to improve. Host-driven by design — it forces a per-step device
+        sync, which only users opting into this policy pay."""
+        if getattr(self.conf, "lr_policy", None) != "score":
+            return
+        s = float(loss)
+        best = self._best_score
+        if best is not None and s >= best:
+            self._lr_score_factor *= getattr(
+                self.conf, "lr_policy_decay_rate", 1.0) or 1.0
+        if best is None or s < best:
+            self._best_score = s
 
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, epochs: int = 1):

@@ -210,7 +210,9 @@ def schedule_lr(conf, step):
 
     Policies per the reference (nn/updater/UpdaterUtils.java:68-93):
     none, exponential, inverse, poly, sigmoid, step, torch_step, schedule.
-    ('score' decay is driven by the training loop, not a formula here.)
+    ('score' returns base here; the containers multiply in a host-tracked
+    decay factor updated when the score fails to improve — see
+    MultiLayerNetwork._apply_score_decay.)
     """
     base = conf.learning_rate
     policy = getattr(conf, "lr_policy", "none") or "none"

@@ -4,11 +4,14 @@ Axis convention (the framework's standard mesh axes; every parallel
 component names these rather than inventing its own):
 
 - ``dp``: data parallel — batch dim sharded, params replicated.
+  Consumed by ParallelWrapper / sharding.shard_batch.
 - ``tp``: tensor parallel — weight matrices sharded, activations gathered
-  by XLA-inserted collectives.
-- ``pp``: pipeline parallel — layer groups per stage.
-- ``sp``: sequence/context parallel — time dim sharded (ring attention).
-- ``ep``: expert parallel — experts sharded (MoE layers).
+  by XLA-inserted collectives. Consumed by sharding.param_shardings.
+- ``sp``: sequence/context parallel — time dim sharded; consumed by
+  parallel.ring_attention (blockwise ring attention over ICI).
+- ``pp``, ``ep``: reserved axis *names* (pipeline / expert parallel) so
+  future components agree on naming; no component consumes them today and
+  make_mesh keeps them at size 1 unless explicitly set.
 
 The reference's ParallelWrapper pins one model replica per device thread
 (ParallelWrapper.java:122,189); here a mesh axis of size N is the

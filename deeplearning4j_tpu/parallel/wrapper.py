@@ -102,40 +102,62 @@ class ParallelWrapper:
         pad = np.zeros((rem,) + tuple(x.shape[1:]), x.dtype)
         return np.concatenate([np.asarray(x), pad], axis=0), rem
 
+    def _pad_with_masks(self, x, y, fm, lm):
+        """Pad one batch's leading dim to a dp multiple, masking padded
+        rows out of the loss. Returns (x, y, fm, lm)."""
+        x, npad = self._pad_batch(np.asarray(x))
+        if npad:
+            y2 = np.asarray(y)
+            ypad = np.zeros((npad,) + y2.shape[1:], y2.dtype)
+            y = np.concatenate([y2, ypad], 0)
+            # mask padding rows out of the loss
+            if lm is None:
+                lm = np.ones(
+                    (x.shape[0],) if y2.ndim == 2
+                    else (x.shape[0], y2.shape[1]), np.float32)
+                lm[-npad:] = 0.0
+            else:
+                lm2 = np.asarray(lm)
+                lm = np.concatenate(
+                    [lm2, np.zeros((npad,) + lm2.shape[1:], lm2.dtype)], 0)
+            if fm is not None:
+                fm2 = np.asarray(fm)
+                fm = np.concatenate(
+                    [fm2, np.zeros((npad,) + fm2.shape[1:], fm2.dtype)], 0)
+        return x, y, fm, lm
+
     # ------------------------------------------------------------------
     def fit(self, data, epochs: int = 1):
         """Train. `data` is any iterator/list of batches the wrapped net
-        accepts (ref fit loop: ParallelWrapper.java:211-260)."""
+        accepts (ref fit loop: ParallelWrapper.java:211-260).
+
+        averaging_frequency == 1 (default): one GSPMD step per batch,
+        per-step gradient all-reduce (SHARED_GRADIENTS semantics).
+        averaging_frequency == k > 1: batches are grouped k at a time and
+        run through LocalStepTrainer — each dp shard takes k local SGD
+        steps on its own data, then params (+ updater state) are pmean'd
+        (AVERAGING semantics, ParallelWrapper.java:320,332-365).
+        """
         self._ensure_sharded()
         net = self.net
         batches = data if hasattr(data, "__iter__") else [data]
+        k = self.averaging_frequency
+        if k > 1 and self._local_step is None:
+            self._local_step = LocalStepTrainer(
+                net, self.mesh, average_updaters=self.average_updaters)
         with self.mesh:
             for _ in range(epochs):
                 if hasattr(batches, "reset"):
                     batches.reset()
+                group = []
                 for batch in batches:
-                    x, y, fm, lm = _as_batch(batch)
-                    x, npad = self._pad_batch(np.asarray(x))
-                    if npad:
-                        y2 = np.asarray(y)
-                        ypad = np.zeros((npad,) + y2.shape[1:], y2.dtype)
-                        y = np.concatenate([y2, ypad], 0)
-                        # mask padding rows out of the loss
-                        if lm is None:
-                            lm = np.ones(
-                                (x.shape[0],) if y2.ndim == 2
-                                else (x.shape[0], y2.shape[1]), np.float32)
-                            lm[-npad:] = 0.0
-                        else:
-                            lm2 = np.asarray(lm)
-                            lm = np.concatenate(
-                                [lm2, np.zeros((npad,) + lm2.shape[1:],
-                                               lm2.dtype)], 0)
-                        if fm is not None:
-                            fm2 = np.asarray(fm)
-                            fm = np.concatenate(
-                                [fm2, np.zeros((npad,) + fm2.shape[1:],
-                                               fm2.dtype)], 0)
+                    x, y, fm, lm = self._pad_with_masks(*_as_batch(batch))
+                    if k > 1:
+                        group.append((x, y, fm, lm))
+                        if len(group) == k:
+                            self._local_step.run(group)
+                            group = []
+                        continue
                     xb = shard_batch(self.mesh, jnp.asarray(x, net.dtype))
                     yb = shard_batch(self.mesh, jnp.asarray(y, net.dtype))
                     fmb = (None if fm is None
@@ -153,15 +175,11 @@ class ParallelWrapper:
                         net._train_step(xb, yb, fmb, lmb)
                     for listener in net.listeners:
                         listener.iteration_done(net, net.iteration)
+                if group:
+                    # trailing group smaller than k: run it as a shorter
+                    # local-step stack (compiled once per distinct size)
+                    self._local_step.run(group)
                 net.epoch += 1
-        return self
-
-    # ------------------------------------------------------------------
-    def average_params(self):
-        """Explicit parameter averaging over dp — the K-step local-SGD
-        rendezvous (ref: Nd4j.averageAndPropagate, ParallelWrapper.java:320).
-        With the default per-step all-reduce params never diverge, so this
-        is a no-op unless local stepping is used."""
         return self
 
     def output(self, x):
@@ -177,52 +195,203 @@ def _as_batch(batch):
 
 class LocalStepTrainer:
     """True `averagingFrequency=k` local-SGD semantics via shard_map:
-    each dp shard carries its own params for k local steps, then params
-    (and optionally updater state) are pmean'd over dp — bit-for-bit the
-    reference's AVERAGING mode (ParallelWrapper.java:320,332-365), but as
-    one compiled program.
+    each dp shard carries its own params for k local steps (gradients of
+    its LOCAL minibatch only — no cross-shard gradient exchange), then
+    params (and optionally updater state + BN running stats) are pmean'd
+    over dp — the reference's AVERAGING mode
+    (ParallelWrapper.java:320, averageUpdatersState :332-365), compiled
+    as one XLA program per group size.
 
     This trades gradient freshness for k× fewer collectives; on ICI the
-    per-step all-reduce is nearly free, so this exists for semantic parity
-    and for DCN-spanning meshes where collectives are expensive.
+    per-step all-reduce is nearly free, so this exists for semantic
+    parity and for DCN-spanning meshes where collectives are expensive.
+
+    Constraints: tp must be 1 (params are replicated inside the shard_map)
+    and the wrapped net must not be in TBPTT carry mode.
     """
 
-    def __init__(self, loss_fn, updater, mesh: Mesh, k: int,
-                 average_updaters: bool = True):
-        self.loss_fn = loss_fn      # (params, x, y) -> scalar loss
-        self.updater = updater      # obj with update(grads, state, params, lr, step)
+    def __init__(self, net, mesh: Mesh, average_updaters: bool = True):
+        if mesh.shape["tp"] != 1:
+            raise NotImplementedError(
+                "averaging_frequency > 1 requires tp == 1 (local-SGD "
+                "shards carry full param replicas)")
+        self.net = net
         self.mesh = mesh
-        self.k = k
         self.average_updaters = average_updaters
+        self._fn_cache = {}
 
-    def build(self):
-        from jax.experimental.shard_map import shard_map
-        mesh, k, loss_fn, updater = self.mesh, self.k, self.loss_fn, self.updater
+    # -------------------------------------------------------------- build
+    def _build(self, k: int, with_fm: bool, with_lm: bool):
+        from deeplearning4j_tpu.nn.updater import schedule_lr
+
+        net = self.net
+        conf = net.conf
         avg_upd = self.average_updaters
+        is_graph = hasattr(conf, "network_inputs")
+        cd = net.compute_dtype
 
-        def worker(params, upd_state, step, xs, ys, lr):
-            # xs: [k, local_batch, ...] — k local steps on this shard's data
-            def one(carry, xy):
-                p, us, s = carry
-                x, y = xy
-                loss, g = jax.value_and_grad(loss_fn)(p, x, y)
-                deltas, us = updater.update(g, us, p, lr, s)
-                p = jax.tree_util.tree_map(lambda a, d: a + d, p, deltas)
-                return (p, us, s + 1), loss
-            (params, upd_state, _), losses = jax.lax.scan(
-                one, (params, upd_state, step), (xs, ys))
-            # rendezvous: average params (+ updater state) over dp
-            params = jax.tree_util.tree_map(
-                lambda a: jax.lax.pmean(a, "dp"), params)
+        def loss_for_grad(params, states, x, y, rng, fm, lm):
+            if cd is not None:
+                from deeplearning4j_tpu.nn.dtype import cast_floating
+                params = cast_floating(params, cd)
+                x = cast_floating(x, cd)
+            loss, (new_states, _) = net._loss_fn(
+                params, states, x, y, rng, fm, lm, rnn_carries=None)
+            if cd is not None:
+                loss = loss.astype(net.dtype)
+            return loss, new_states
+
+        if is_graph:
+            layer_names = [n.name for n in net.topo if n.kind == "layer"]
+            frozen = {n.name for n in net.topo
+                      if n.kind == "layer" and n.obj.frozen}
+            lr_factors = {
+                n.name: ((n.obj.learning_rate / conf.learning_rate)
+                         if getattr(n.obj, "learning_rate", None) is not None
+                         and conf.learning_rate != 0 else 1.0)
+                for n in net.topo if n.kind == "layer"}
+
+            def apply_updates(params, upd_states, grads, lr, step):
+                new_p, new_u = {}, {}
+                for name in layer_names:
+                    if name in frozen:
+                        new_p[name] = params[name]
+                        new_u[name] = upd_states[name]
+                        continue
+                    deltas, us = net._updaters[name].update(
+                        grads[name], upd_states[name], params[name],
+                        lr * lr_factors[name], step)
+                    new_p[name] = jax.tree_util.tree_map(
+                        lambda p, d: p + d, params[name], deltas)
+                    new_u[name] = us
+                return new_p, new_u
+        else:
+            lr_factors = [
+                (l.learning_rate / conf.learning_rate)
+                if l.learning_rate is not None and conf.learning_rate != 0
+                else 1.0 for l in conf.layers]
+
+            def apply_updates(params, upd_states, grads, lr, step):
+                new_p, new_u = [], []
+                for i in range(len(params)):
+                    if conf.layers[i].frozen:
+                        new_p.append(params[i])
+                        new_u.append(upd_states[i])
+                        continue
+                    deltas, us = net._updaters[i].update(
+                        grads[i], upd_states[i], params[i],
+                        lr * lr_factors[i], step)
+                    new_p.append(jax.tree_util.tree_map(
+                        lambda p, d: p + d, params[i], deltas))
+                    new_u.append(us)
+                return new_p, new_u
+
+        def worker(params, upd_states, states, step0, xs, ys, fms, lms,
+                   rng, lr_scale):
+            # decorrelate dropout across shards
+            rng = jax.random.fold_in(rng, jax.lax.axis_index("dp"))
+            keys = jax.random.split(rng, k)
+
+            def one(carry, sl):
+                params, upd_states, states, step = carry
+                x, y, fm, lm, key = sl
+                (loss, new_states), grads = jax.value_and_grad(
+                    loss_for_grad, has_aux=True)(
+                        params, states, x, y, key, fm, lm)
+                grads = net._clip_grads(grads)
+                lr = schedule_lr(conf, step) * lr_scale
+                params, upd_states = apply_updates(
+                    params, upd_states, grads, lr, step)
+                return (params, upd_states, new_states, step + 1), loss
+
+            (params, upd_states, states, _), losses = jax.lax.scan(
+                one, (params, upd_states, states, step0),
+                (xs, ys, fms, lms, keys))
+            # rendezvous: average over dp
+            pmean = lambda t: jax.tree_util.tree_map(
+                lambda a: jax.lax.pmean(a, "dp"), t)
+            params = pmean(params)
+            states = pmean(states)
             if avg_upd:
-                upd_state = jax.tree_util.tree_map(
-                    lambda a: jax.lax.pmean(a, "dp"), upd_state)
-            return params, upd_state, jax.lax.pmean(jnp.mean(losses), "dp")
+                upd_states = pmean(upd_states)
+            return (params, upd_states, states,
+                    jax.lax.pmean(jnp.mean(losses), "dp"))
 
-        pspec = P()          # params replicated at entry/exit
-        xspec = P(None, "dp")  # [k, batch, ...] batch dim sharded
-        return jax.jit(shard_map(
-            worker, mesh=mesh,
-            in_specs=(pspec, pspec, P(), xspec, xspec, P()),
-            out_specs=(pspec, pspec, P()),
-            check_rep=False))
+        rep = P()             # replicated at entry/exit
+        xspec = P(None, "dp")  # [k, batch, ...]: batch dim sharded
+        fspec = xspec if with_fm else rep
+        lspec = xspec if with_lm else rep
+        return jax.jit(jax.shard_map(
+            worker, mesh=self.mesh,
+            in_specs=(rep, rep, rep, rep, xspec, xspec, fspec, lspec,
+                      rep, rep),
+            out_specs=(rep, rep, rep, rep),
+            check_vma=False),
+            donate_argnums=(0, 1, 2))
+
+    # ---------------------------------------------------------------- run
+    def run(self, group):
+        """Run one k-step local-SGD group. `group` is a list of
+        (x, y, fm, lm) host batches (batch dims already dp-padded)."""
+        net = self.net
+        k = len(group)
+        # equalize batch sizes across the group (fully-masked pad rows)
+        bmax = max(np.asarray(g[0]).shape[0] for g in group)
+        any_fm = any(g[2] is not None for g in group)
+        any_lm = any(g[3] is not None for g in group)
+        xs, ys, fms, lms = [], [], [], []
+        for x, y, fm, lm in group:
+            x, y = np.asarray(x), np.asarray(y)
+            if any_lm and lm is None:
+                lm = np.ones((x.shape[0],) if y.ndim == 2
+                             else (x.shape[0], y.shape[1]), np.float32)
+            if any_fm and fm is None:
+                fm = np.ones((x.shape[0],) + (() if x.ndim == 2
+                                              else (x.shape[1],)),
+                             np.float32)
+            n = bmax - x.shape[0]
+            if n:
+                pad = lambda a: np.concatenate(
+                    [a, np.zeros((n,) + a.shape[1:], a.dtype)], 0)
+                x, y = pad(x), pad(y)
+                if lm is None:
+                    lm = np.ones((x.shape[0],) if y.ndim == 2
+                                 else (x.shape[0], y.shape[1]), np.float32)
+                    lm[-n:] = 0.0
+                else:
+                    lm = pad(lm)
+                if fm is not None:
+                    fm = pad(fm)
+            xs.append(x); ys.append(y); fms.append(fm); lms.append(lm)
+        any_lm = any(m is not None for m in lms)
+        xs = jnp.asarray(np.stack(xs), net.dtype)
+        ys = jnp.asarray(np.stack(ys), net.dtype)
+        fms = jnp.asarray(np.stack(fms)) if any_fm else None
+        lms = jnp.asarray(np.stack(lms)) if any_lm else None
+
+        is_graph = hasattr(net.conf, "network_inputs")
+        if is_graph:
+            name = net.conf.network_inputs[0]
+            xs_in = {name: xs}
+            ys_in = [ys]
+            fms_in = None if fms is None else {name: fms}
+            lms_in = None if lms is None else [lms]
+        else:
+            xs_in, ys_in, fms_in, lms_in = xs, ys, fms, lms
+
+        key = (k, fms is not None, lms is not None, is_graph)
+        if key not in self._fn_cache:
+            self._fn_cache[key] = self._build(
+                k, fms is not None, lms is not None)
+        net._rng, sub = jax.random.split(net._rng)
+        (net.params, net.updater_states, net.states, loss) = \
+            self._fn_cache[key](
+                net.params, net.updater_states, net.states,
+                jnp.asarray(net.iteration, jnp.int32),
+                xs_in, ys_in, fms_in, lms_in, sub,
+                jnp.asarray(net._lr_score_factor, jnp.float32))
+        net.iteration += k
+        net._score = loss
+        for listener in net.listeners:
+            listener.iteration_done(net, net.iteration)
+        return loss

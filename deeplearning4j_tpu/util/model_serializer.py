@@ -7,8 +7,7 @@ TPU-native differences: coefficients are stored as an .npz of named
 per-layer arrays (a pytree, not one flattened view) so sharded/partial
 restore is possible; the zip layout and entry names stay recognizable for
 interop. BatchNorm running stats (which the reference folds into params)
-live in their own entry. For multi-host sharded checkpoints at scale, use
-orbax via `save_sharded` (thin wrapper, optional).
+live in their own entry.
 """
 
 from __future__ import annotations
