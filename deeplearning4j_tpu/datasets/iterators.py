@@ -281,6 +281,12 @@ class DevicePrefetchIterator(DataSetIterator):
         self._staged = None
 
     def __iter__(self):
+        if self._staged is not None:
+            # an iteration is already staged (has_next() or a prior
+            # __iter__); keep it — restaging would drop the buffered
+            # batches when base is a one-shot generator. reset() starts
+            # a genuinely fresh pass.
+            return self
         self._src = iter(self.base)
         self._staged = []
         for _ in range(self.buffer_size):
@@ -299,6 +305,9 @@ class DevicePrefetchIterator(DataSetIterator):
         if self._staged is None:
             self.__iter__()
         if not self._staged:
+            # exhausted: clear the stage marker so the next __iter__
+            # starts a fresh pass over base (multi-epoch reuse)
+            self._staged = None
             raise StopIteration
         out = self._staged.pop(0)
         try:

@@ -303,8 +303,8 @@ class ComputationGraph:
         return loss, new_carries
 
     def _apply_score_decay(self, loss):
-        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-        MultiLayerNetwork._apply_score_decay(self, loss)
+        from deeplearning4j_tpu.nn.updater import apply_score_decay
+        apply_score_decay(self, loss)
 
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, epochs: int = 1):

@@ -322,19 +322,8 @@ class MultiLayerNetwork:
         return loss, new_carries
 
     def _apply_score_decay(self, loss):
-        """lr_policy='score' (ref: LearningRatePolicy.Score, applied in
-        BaseOptimizer): multiply lr by decay_rate whenever the score fails
-        to improve. Host-driven by design — it forces a per-step device
-        sync, which only users opting into this policy pay."""
-        if getattr(self.conf, "lr_policy", None) != "score":
-            return
-        s = float(loss)
-        best = self._best_score
-        if best is not None and s >= best:
-            self._lr_score_factor *= getattr(
-                self.conf, "lr_policy_decay_rate", 1.0) or 1.0
-        if best is None or s < best:
-            self._best_score = s
+        from deeplearning4j_tpu.nn.updater import apply_score_decay
+        apply_score_decay(self, loss)
 
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, epochs: int = 1):

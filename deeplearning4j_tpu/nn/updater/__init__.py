@@ -244,3 +244,20 @@ def schedule_lr(conf, step):
             lr = jnp.where(it >= k, sched[k], lr)
         return lr
     raise ValueError(f"Unknown lr policy '{policy}'")
+
+
+def apply_score_decay(net, loss):
+    """lr_policy='score' (ref: LearningRatePolicy.Score, applied in
+    BaseOptimizer): multiply the host-tracked lr factor by decay_rate
+    whenever the score fails to improve. Shared by both containers and
+    the local-SGD trainer. Host-driven by design — it forces a per-step
+    device sync, which only users opting into this policy pay."""
+    if getattr(net.conf, "lr_policy", None) != "score":
+        return
+    s = float(loss)
+    best = net._best_score
+    if best is not None and s >= best:
+        net._lr_score_factor *= getattr(
+            net.conf, "lr_policy_decay_rate", 1.0) or 1.0
+    if best is None or s < best:
+        net._best_score = s

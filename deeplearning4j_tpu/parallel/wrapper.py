@@ -363,6 +363,13 @@ class LocalStepTrainer:
                 if fm is not None:
                     fm = pad(fm)
             xs.append(x); ys.append(y); fms.append(fm); lms.append(lm)
+        # equalization padding may have created masks for only some
+        # batches; fill the rest with ones so stacking is uniform
+        if any(m is not None for m in lms):
+            lms = [np.ones((x.shape[0],) if y.ndim == 2
+                           else (x.shape[0], y.shape[1]), np.float32)
+                   if lm is None else lm
+                   for x, y, lm in zip(xs, ys, lms)]
         any_lm = any(m is not None for m in lms)
         xs = jnp.asarray(np.stack(xs), net.dtype)
         ys = jnp.asarray(np.stack(ys), net.dtype)
@@ -379,7 +386,17 @@ class LocalStepTrainer:
         else:
             xs_in, ys_in, fms_in, lms_in = xs, ys, fms, lms
 
-        key = (k, fms is not None, lms is not None, is_graph)
+        # frozen flags are baked into the trace (same contract as the
+        # containers' per-step cache): key on them so freeze/unfreeze
+        # between fits takes effect
+        if is_graph:
+            frozen_sig = tuple(sorted(
+                n.name for n in net.topo
+                if n.kind == "layer" and n.obj.frozen))
+        else:
+            frozen_sig = tuple(i for i, l in enumerate(net.conf.layers)
+                               if l.frozen)
+        key = (k, fms is not None, lms is not None, is_graph, frozen_sig)
         if key not in self._fn_cache:
             self._fn_cache[key] = self._build(
                 k, fms is not None, lms is not None)
@@ -392,6 +409,7 @@ class LocalStepTrainer:
                 jnp.asarray(net._lr_score_factor, jnp.float32))
         net.iteration += k
         net._score = loss
+        net._apply_score_decay(loss)
         for listener in net.listeners:
             listener.iteration_done(net, net.iteration)
         return loss
