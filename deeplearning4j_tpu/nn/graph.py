@@ -28,7 +28,8 @@ from deeplearning4j_tpu.nn.layers.recurrent import (
     LSTM,
     GravesBidirectionalLSTM,
 )
-from deeplearning4j_tpu.nn.updater import get_updater, schedule_lr
+from deeplearning4j_tpu.nn.updater import (fused_apply, get_updater,
+                                            schedule_lr)
 
 
 def _as_multi(data) -> Tuple[List, List, Optional[List], Optional[List]]:
@@ -265,19 +266,12 @@ class ComputationGraph:
             lr = schedule_lr(conf, step) * lr_scale
             frozen = {n.name for n in self.topo
                       if n.kind == "layer" and n.obj.frozen}
-            new_params = {}
-            new_upd = {}
-            for name in layer_names:
-                if name in frozen:
-                    new_params[name] = params[name]
-                    new_upd[name] = upd_states[name]
-                    continue
-                deltas, us = updaters[name].update(
-                    grads[name], upd_states[name], params[name],
-                    lr * lr_factors[name], step)
-                new_params[name] = jax.tree_util.tree_map(
-                    lambda p, d: p + d, params[name], deltas)
-                new_upd[name] = us
+            np_list, nu_list = fused_apply(
+                [(updaters[name], lr_factors[name], name in frozen,
+                  params[name], grads[name], upd_states[name])
+                 for name in layer_names], lr, step)
+            new_params = dict(zip(layer_names, np_list))
+            new_upd = dict(zip(layer_names, nu_list))
             return new_params, new_upd, new_states, new_carries, loss
 
         return jax.jit(step_fn, donate_argnums=(0, 1, 2))

@@ -16,6 +16,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -26,6 +27,68 @@ from deeplearning4j_tpu.nn.conf.inputs import (
     InputTypeRecurrent,
 )
 from deeplearning4j_tpu.nn.layers.base import Layer
+from functools import partial
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _bn_train(x, gamma, beta, eps):
+    """Train-mode batchnorm with a hand-written 2-pass backward.
+
+    Autodiff of the naive formulation emits three separate full
+    reductions over the activation tensor in the backward (d-gamma,
+    d-beta, and the mean/var chain) — profiled at ~25% of a ResNet50
+    step. The classic fused backward needs only two passes:
+      pass 1: dbeta = sum(dy), dgamma = sum(dy * xhat)  (sibling
+              reductions over one read, multi-output-fused by XLA)
+      pass 2: dx = gamma*r * (dy - xhat*dgamma/N - dbeta/N)
+    This is the cuDNN-helper-tier equivalent for BN
+    (CudnnBatchNormalizationHelper.java) realized as a custom VJP.
+
+    Returns (y, mean, var). Cotangents through mean/var are treated as
+    zero: they feed only the running-stat EMA, which is never
+    differentiated (it is aux state in the train step).
+    """
+    y, mean, var, _ = _bn_fwd_impl(x, gamma, beta, eps)
+    return y, mean, var
+
+
+def _bn_fwd_impl(x, gamma, beta, eps):
+    axes = tuple(range(x.ndim - 1))
+    st = jnp.promote_types(x.dtype, jnp.float32)   # f32 accum; f64 in
+    mean = jnp.mean(x, axis=axes, dtype=st)        # gradcheck mode
+    mean2 = jnp.mean(jnp.square(x.astype(st)), axis=axes)
+    var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
+    r = lax.rsqrt(var + eps)
+    scale = gamma.astype(st) * r
+    shift = beta.astype(st) - mean * scale
+    y = x * scale.astype(x.dtype) + shift.astype(x.dtype)
+    return y, mean, var, r
+
+
+def _bn_train_fwd(x, gamma, beta, eps):
+    y, mean, var, r = _bn_fwd_impl(x, gamma, beta, eps)
+    return (y, mean, var), (x, gamma, mean, r)
+
+
+def _bn_train_bwd(eps, res, cts):
+    dy, _, _ = cts   # mean/var cotangents: zero by construction (EMA aux)
+    x, gamma, mean, r = res
+    axes = tuple(range(x.ndim - 1))
+    n = x.size // x.shape[-1]
+    mean_c = mean.astype(x.dtype)
+    r_c = r.astype(x.dtype)
+    st = jnp.promote_types(x.dtype, jnp.float32)
+    xhat = (x - mean_c) * r_c
+    dyf = dy.astype(st)
+    dgamma = jnp.sum(dyf * xhat.astype(st), axis=axes)
+    dbeta = jnp.sum(dyf, axis=axes)
+    k = (gamma.astype(st) * r).astype(x.dtype)
+    dx = k * (dy - (xhat * (dgamma / n).astype(x.dtype))
+              - (dbeta / n).astype(x.dtype))
+    return dx, dgamma.astype(gamma.dtype), dbeta.astype(gamma.dtype)
+
+
+_bn_train.defvjp(_bn_train_fwd, _bn_train_bwd)
 
 
 @dataclass(kw_only=True)
@@ -78,9 +141,28 @@ class BatchNormalization(Layer):
         in_dtype = x.dtype
         stat_dtype = jnp.float32 if is_low_precision(in_dtype) else in_dtype
         axes = tuple(range(x.ndim - 1))
-        if train:
+
+        def batch_stats(x):
+            # one-pass E[x^2]-E[x]^2 (two sibling reductions over the same
+            # read, multi-output-fused by XLA) instead of jnp.var's
+            # mean-then-deviations second pass — BN is HBM-bound, so this
+            # saves a full activation read per BN in fwd and bwd
             mean = jnp.mean(x, axis=axes, dtype=stat_dtype)
-            var = jnp.var(x.astype(stat_dtype), axis=axes)
+            mean2 = jnp.mean(jnp.square(x.astype(stat_dtype)), axis=axes)
+            var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
+            return mean, var
+
+        if train:
+            # fused-backward path (see _bn_train): gamma/beta as arrays
+            c = x.shape[-1]
+            if not self.lock_gamma_beta and params:
+                gamma, beta = params["gamma"], params["beta"]
+            else:
+                g0 = self.gamma if self.lock_gamma_beta else 1.0
+                b0 = self.beta if self.lock_gamma_beta else 0.0
+                gamma = jnp.full((c,), g0, stat_dtype)
+                beta = jnp.full((c,), b0, stat_dtype)
+            y, mean, var = _bn_train(x, gamma, beta, self.eps)
             new_state = None
             if state is not None:
                 d = self.decay
@@ -88,12 +170,12 @@ class BatchNormalization(Layer):
                     "mean": d * state["mean"] + (1.0 - d) * mean,
                     "var": d * state["var"] + (1.0 - d) * var,
                 }
+            return y, new_state
         else:
             if state is not None:
                 mean, var = state["mean"], state["var"]
             else:
-                mean = jnp.mean(x, axis=axes, dtype=stat_dtype)
-                var = jnp.var(x.astype(stat_dtype), axis=axes)
+                mean, var = batch_stats(x)
             new_state = state
 
         scale = lax.rsqrt(var + self.eps)

@@ -33,7 +33,8 @@ from deeplearning4j_tpu.nn.conf.network import (
 from deeplearning4j_tpu.nn.layers.base import Layer
 from deeplearning4j_tpu.nn.layers.core import BaseOutputLayer
 from deeplearning4j_tpu.nn.layers.recurrent import LSTM, GravesBidirectionalLSTM
-from deeplearning4j_tpu.nn.updater import get_updater, schedule_lr
+from deeplearning4j_tpu.nn.updater import (fused_apply, get_updater,
+                                            schedule_lr)
 
 
 def _as_batch(data) -> Tuple:
@@ -285,19 +286,10 @@ class MultiLayerNetwork:
                     carries if with_carries else None)
             grads = self._clip_grads(grads)
             lr = schedule_lr(conf, step) * lr_scale
-            new_params = []
-            new_upd = []
-            for i in range(len(params)):
-                if conf.layers[i].frozen:
-                    new_params.append(params[i])
-                    new_upd.append(upd_states[i])
-                    continue
-                deltas, us = updaters[i].update(
-                    grads[i], upd_states[i], params[i],
-                    lr * lr_factors[i], step)
-                new_params.append(jax.tree_util.tree_map(
-                    lambda p, d: p + d, params[i], deltas))
-                new_upd.append(us)
+            new_params, new_upd = fused_apply(
+                [(updaters[i], lr_factors[i], conf.layers[i].frozen,
+                  params[i], grads[i], upd_states[i])
+                 for i in range(len(params))], lr, step)
             return new_params, new_upd, new_states, new_carries, loss
 
         return jax.jit(step_fn, donate_argnums=(0, 1, 2))

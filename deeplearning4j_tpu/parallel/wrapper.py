@@ -252,19 +252,13 @@ class LocalStepTrainer:
                 for n in net.topo if n.kind == "layer"}
 
             def apply_updates(params, upd_states, grads, lr, step):
-                new_p, new_u = {}, {}
-                for name in layer_names:
-                    if name in frozen:
-                        new_p[name] = params[name]
-                        new_u[name] = upd_states[name]
-                        continue
-                    deltas, us = net._updaters[name].update(
-                        grads[name], upd_states[name], params[name],
-                        lr * lr_factors[name], step)
-                    new_p[name] = jax.tree_util.tree_map(
-                        lambda p, d: p + d, params[name], deltas)
-                    new_u[name] = us
-                return new_p, new_u
+                from deeplearning4j_tpu.nn.updater import fused_apply
+                np_list, nu_list = fused_apply(
+                    [(net._updaters[name], lr_factors[name], name in frozen,
+                      params[name], grads[name], upd_states[name])
+                     for name in layer_names], lr, step)
+                return (dict(zip(layer_names, np_list)),
+                        dict(zip(layer_names, nu_list)))
         else:
             lr_factors = [
                 (l.learning_rate / conf.learning_rate)
@@ -272,19 +266,11 @@ class LocalStepTrainer:
                 else 1.0 for l in conf.layers]
 
             def apply_updates(params, upd_states, grads, lr, step):
-                new_p, new_u = [], []
-                for i in range(len(params)):
-                    if conf.layers[i].frozen:
-                        new_p.append(params[i])
-                        new_u.append(upd_states[i])
-                        continue
-                    deltas, us = net._updaters[i].update(
-                        grads[i], upd_states[i], params[i],
-                        lr * lr_factors[i], step)
-                    new_p.append(jax.tree_util.tree_map(
-                        lambda p, d: p + d, params[i], deltas))
-                    new_u.append(us)
-                return new_p, new_u
+                from deeplearning4j_tpu.nn.updater import fused_apply
+                return fused_apply(
+                    [(net._updaters[i], lr_factors[i], conf.layers[i].frozen,
+                      params[i], grads[i], upd_states[i])
+                     for i in range(len(params))], lr, step)
 
         def worker(params, upd_states, states, step0, xs, ys, fms, lms,
                    rng, lr_scale):
@@ -385,6 +371,18 @@ class LocalStepTrainer:
             lms_in = None if lms is None else [lms]
         else:
             xs_in, ys_in, fms_in, lms_in = xs, ys, fms, lms
+        return self.run_arrays(xs_in, ys_in, fms_in, lms_in, k=k)
+
+    def run_arrays(self, xs_in, ys_in, fms_in=None, lms_in=None, k=None):
+        """Run one k-step local-SGD group on pre-staged arrays with a
+        leading [k, ...] step dim. Device-resident arrays can be passed
+        repeatedly without re-staging — this is how the bench amortizes
+        host->device transfer and per-dispatch latency over k steps."""
+        net = self.net
+        is_graph = hasattr(net.conf, "network_inputs")
+        if k is None:
+            lead = (next(iter(xs_in.values())) if is_graph else xs_in)
+            k = int(lead.shape[0])
 
         # frozen flags are baked into the trace (same contract as the
         # containers' per-step cache): key on them so freeze/unfreeze
@@ -396,10 +394,11 @@ class LocalStepTrainer:
         else:
             frozen_sig = tuple(i for i, l in enumerate(net.conf.layers)
                                if l.frozen)
-        key = (k, fms is not None, lms is not None, is_graph, frozen_sig)
+        key = (k, fms_in is not None, lms_in is not None, is_graph,
+               frozen_sig)
         if key not in self._fn_cache:
             self._fn_cache[key] = self._build(
-                k, fms is not None, lms is not None)
+                k, fms_in is not None, lms_in is not None)
         net._rng, sub = jax.random.split(net._rng)
         (net.params, net.updater_states, net.states, loss) = \
             self._fn_cache[key](
