@@ -1,0 +1,4 @@
+from deeplearning4j_tpu.modelimport.keras import (  # noqa: F401
+    KerasModelImport,
+    KerasImportError,
+)

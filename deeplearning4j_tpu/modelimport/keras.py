@@ -1,0 +1,614 @@
+"""Keras HDF5 model importer.
+
+Parity: deeplearning4j-modelimport
+(nn/modelimport/keras/KerasModelImport.java:48-119 — entry points;
+KerasModel.java / KerasSequentialModel.java — config+weights mapping;
+KerasLayer.java — the supported layer-type table; Hdf5Archive.java — the
+HDF5 reader, replaced here by h5py per SURVEY §2.3).
+
+Reads whole-model HDF5 files (`model.save("m.h5")`): `model_config` JSON
+attr + `model_weights/` groups (+ optional `training_config` for the
+loss). Supports both the legacy Keras-2-style and current Keras-3 weight
+path layouts by following each layer group's `weight_names` attr and
+falling back to a dataset walk.
+
+Layer mappings (reference table: KerasLayer.java):
+  InputLayer, Dense, Conv2D, MaxPooling2D, AveragePooling2D,
+  GlobalMaxPooling2D, GlobalAveragePooling2D, Flatten (auto CnnToFF
+  preprocessor), Dropout, Activation, BatchNormalization, Embedding,
+  LSTM, ZeroPadding2D, Add/Concatenate/... merge layers (functional
+  graphs), Loss (from training_config).
+
+Dim ordering: this framework is natively NHWC == TensorFlow
+channels_last, so Conv kernels (kh, kw, in, out) and Dense kernels
+(in, out) copy without transposition (the reference needed
+TensorFlowCnnToFeedForwardPreProcessor for this; here it is the identity
+case). channels_first models are rejected with a clear error.
+LSTM gate order is remapped keras [i, f, g, o] -> ours [i, f, o, g].
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf import InputType
+from deeplearning4j_tpu.nn.conf.graph_conf import GraphBuilder
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers import (
+    LSTM,
+    ActivationLayer,
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    DropoutLayer,
+    EmbeddingLayer,
+    GlobalPoolingLayer,
+    OutputLayer,
+    SubsamplingLayer,
+    ZeroPaddingLayer,
+)
+from deeplearning4j_tpu.nn.conf.graph_vertices import (
+    ElementWiseVertex,
+    LastTimeStepVertex,
+    MergeVertex,
+    PreprocessorVertex,
+)
+from deeplearning4j_tpu.nn.conf.preprocessors import (
+    CnnToFeedForwardPreProcessor,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+class KerasImportError(ValueError):
+    """Unsupported or malformed Keras model (ref:
+    InvalidKerasConfigurationException / UnsupportedKerasConfigurationException)."""
+
+
+_ACTIVATIONS = {
+    "linear": "identity",
+    "relu": "relu",
+    "relu6": "relu6",
+    "elu": "elu",
+    "selu": "selu",
+    "tanh": "tanh",
+    "sigmoid": "sigmoid",
+    "hard_sigmoid": "hardsigmoid",
+    "softmax": "softmax",
+    "softplus": "softplus",
+    "softsign": "softsign",
+    "swish": "swish",
+    "silu": "swish",
+    "gelu": "gelu",
+    "leaky_relu": "leakyrelu",
+    "mish": "mish",
+}
+
+_LOSSES = {
+    "categorical_crossentropy": "mcxent",
+    "sparse_categorical_crossentropy": "mcxent",
+    "binary_crossentropy": "xent",
+    "mean_squared_error": "mse",
+    "mse": "mse",
+    "mean_absolute_error": "mae",
+    "mae": "mae",
+    "mean_absolute_percentage_error": "mape",
+    "mean_squared_logarithmic_error": "msle",
+    "hinge": "hinge",
+    "squared_hinge": "squared_hinge",
+    "poisson": "poisson",
+    "kullback_leibler_divergence": "kl_divergence",
+    "kl_divergence": "kl_divergence",
+    "cosine_proximity": "cosine_proximity",
+}
+
+
+def _map_activation(name) -> str:
+    if name is None:
+        return "identity"
+    if isinstance(name, dict):   # serialized Activation object
+        name = name.get("class_name", "linear")
+    key = str(name).lower()
+    if key not in _ACTIVATIONS:
+        raise KerasImportError(
+            f"Unsupported Keras activation '{name}'. "
+            f"Supported: {sorted(_ACTIVATIONS)}")
+    return _ACTIVATIONS[key]
+
+
+def _map_loss(name: Optional[str]) -> Optional[str]:
+    if name is None:
+        return None
+    if isinstance(name, dict):
+        name = (name.get("config") or {}).get("name") or name.get(
+            "class_name", "")
+    key = str(name).lower()
+    return _LOSSES.get(key)
+
+
+def _check_channels_last(cfg: dict, cls: str):
+    df = cfg.get("data_format", "channels_last")
+    if df not in (None, "channels_last"):
+        raise KerasImportError(
+            f"{cls}: data_format='{df}' (Theano/channels_first ordering) "
+            "is not supported; re-save the model with channels_last")
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (list, tuple)):
+        return int(v[0]), int(v[1] if len(v) > 1 else v[0])
+    return int(v), int(v)
+
+
+def _input_type_from_shape(shape) -> InputType:
+    """batch_shape/batch_input_shape (leading None) -> InputType."""
+    dims = [d for d in shape[1:]]
+    if len(dims) == 3:
+        h, w, c = dims
+        return InputType.convolutional(int(h), int(w), int(c))
+    if len(dims) == 2:
+        t, f = dims
+        return InputType.recurrent(int(f), None if t is None else int(t))
+    if len(dims) == 1:
+        return InputType.feed_forward(int(dims[0]))
+    raise KerasImportError(f"Unsupported Keras input shape {shape}")
+
+
+# --------------------------------------------------------------------- HDF5
+
+def _read_archive(path: str):
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        raw = f.attrs.get("model_config")
+        if raw is None:
+            raise KerasImportError(
+                f"{path}: no model_config attr — not a whole-model Keras "
+                "HDF5 file (weights-only files need the architecture too)")
+        if isinstance(raw, bytes):
+            raw = raw.decode("utf-8")
+        model_config = json.loads(raw)
+        tc = f.attrs.get("training_config")
+        training_config = None
+        if tc is not None:
+            training_config = json.loads(
+                tc.decode("utf-8") if isinstance(tc, bytes) else tc)
+
+        weights: Dict[str, Dict[str, np.ndarray]] = {}
+        mw = f.get("model_weights", f)   # some files are rooted at /
+        for lname in mw:
+            grp = mw[lname]
+            if not hasattr(grp, "attrs"):
+                continue
+            found: Dict[str, np.ndarray] = {}
+            wnames = grp.attrs.get("weight_names")
+            if wnames is not None and len(wnames):
+                for wn in wnames:
+                    wn = wn.decode() if isinstance(wn, bytes) else str(wn)
+                    ds = grp.get(wn) or f.get(wn) or mw.get(wn)
+                    if ds is not None:
+                        leaf = wn.split("/")[-1].split(":")[0]
+                        found[leaf] = np.asarray(ds)
+            else:
+                def walk(g):
+                    import h5py as _h
+                    for k in g:
+                        it = g[k]
+                        if isinstance(it, _h.Dataset):
+                            found[k.split(":")[0]] = np.asarray(it)
+                        else:
+                            walk(it)
+                walk(grp)
+            if found:
+                weights[lname] = found
+    return model_config, weights, training_config
+
+
+# ----------------------------------------------------------- layer mapping
+
+def _map_layer(cls: str, cfg: dict, *, is_output: bool, loss: Optional[str]):
+    """Return a framework layer, 'flatten' (skip marker), or None (skip).
+
+    Ref: the per-type Keras*.java mapping classes
+    (KerasDense.java, KerasConvolution.java, KerasLstm.java, ...)."""
+    if cls == "Dense":
+        act = _map_activation(cfg.get("activation"))
+        if is_output:
+            return OutputLayer(n_out=int(cfg["units"]), activation=act,
+                               loss=loss or "mcxent")
+        return DenseLayer(n_out=int(cfg["units"]), activation=act)
+    if cls in ("Conv2D", "Convolution2D"):
+        _check_channels_last(cfg, cls)
+        kh, kw = _pair(cfg.get("kernel_size", 3))
+        sh, sw = _pair(cfg.get("strides", 1))
+        same = cfg.get("padding", "valid") == "same"
+        dh, dw = _pair(cfg.get("dilation_rate", 1))
+        return ConvolutionLayer(
+            n_out=int(cfg["filters"]), kernel_size=(kh, kw),
+            stride=(sh, sw), dilation=(dh, dw),
+            convolution_mode="same" if same else "truncate",
+            padding=(0, 0),
+            activation=_map_activation(cfg.get("activation")))
+    if cls in ("MaxPooling2D", "AveragePooling2D"):
+        _check_channels_last(cfg, cls)
+        kh, kw = _pair(cfg.get("pool_size", 2))
+        strides = cfg.get("strides") or (kh, kw)
+        sh, sw = _pair(strides)
+        same = cfg.get("padding", "valid") == "same"
+        return SubsamplingLayer(
+            pooling_type="max" if cls.startswith("Max") else "avg",
+            kernel_size=(kh, kw), stride=(sh, sw),
+            convolution_mode="same" if same else "truncate")
+    if cls in ("GlobalMaxPooling2D", "GlobalAveragePooling2D",
+               "GlobalMaxPooling1D", "GlobalAveragePooling1D"):
+        return GlobalPoolingLayer(
+            pooling_type="max" if "Max" in cls else "avg")
+    if cls == "Flatten":
+        return "flatten"
+    if cls == "Dropout":
+        return DropoutLayer(dropout=float(cfg.get("rate", 0.5)))
+    if cls == "Activation":
+        return ActivationLayer(
+            activation=_map_activation(cfg.get("activation")))
+    if cls == "BatchNormalization":
+        axis = cfg.get("axis", -1)
+        if isinstance(axis, (list, tuple)) and len(axis) == 1:
+            axis = axis[0]
+        if axis not in (-1, 3):
+            # this framework normalizes the trailing (channel) axis; a
+            # non-last axis is the channels_first BN layout
+            raise KerasImportError(
+                f"BatchNormalization axis={axis} is not the trailing "
+                "axis (channels_first layout?); only channels_last "
+                "models are supported")
+        return BatchNormalization(
+            eps=float(cfg.get("epsilon", 1e-3)),
+            decay=float(cfg.get("momentum", 0.99)))
+    if cls == "Embedding":
+        return EmbeddingLayer(n_in=int(cfg["input_dim"]),
+                              n_out=int(cfg["output_dim"]))
+    if cls == "LSTM":
+        return LSTM(n_out=int(cfg["units"]),
+                    activation=_map_activation(cfg.get("activation", "tanh")),
+                    gate_activation=_map_activation(
+                        cfg.get("recurrent_activation", "sigmoid")))
+    if cls == "ZeroPadding2D":
+        _check_channels_last(cfg, cls)
+        p = cfg.get("padding", 1)
+        if isinstance(p, (list, tuple)) and len(p) == 2 \
+                and isinstance(p[0], (list, tuple)):
+            (t, b), (l, r) = p
+            return ZeroPaddingLayer(padding=(int(t), int(b), int(l), int(r)))
+        ph, pw = _pair(p)
+        return ZeroPaddingLayer(padding=(ph, pw))
+    if cls == "InputLayer":
+        return None
+    raise KerasImportError(
+        f"Unsupported Keras layer type '{cls}' "
+        "(ref KerasLayer.java supported-type table)")
+
+
+_MERGE_CLASSES = {"Add": "add", "Subtract": "subtract",
+                  "Multiply": "product", "Average": "average",
+                  "Maximum": "max"}
+
+
+# -------------------------------------------------------------- weight copy
+
+def _reorder_lstm(k: np.ndarray, H: int) -> np.ndarray:
+    """keras gate blocks [i, f, g, o] -> ours [i, f, o, g] (last axis)."""
+    i, f, g, o = (k[..., 0:H], k[..., H:2 * H],
+                  k[..., 2 * H:3 * H], k[..., 3 * H:4 * H])
+    return np.concatenate([i, f, o, g], axis=-1)
+
+
+def _params_from_keras(layer, w: Dict[str, np.ndarray]):
+    """Map a keras layer's weight dict onto (params, state) for `layer`."""
+    dt = jnp.float32
+    if isinstance(layer, (DenseLayer, OutputLayer)):
+        return ({"W": jnp.asarray(w["kernel"], dt),
+                 "b": jnp.asarray(w.get("bias",
+                                        np.zeros(w["kernel"].shape[1])), dt)},
+                None)
+    if isinstance(layer, ConvolutionLayer):
+        return ({"W": jnp.asarray(w["kernel"], dt),
+                 "b": jnp.asarray(
+                     w.get("bias", np.zeros(w["kernel"].shape[-1])), dt)},
+                None)
+    if isinstance(layer, BatchNormalization):
+        c = w["gamma"].shape[0] if "gamma" in w else \
+            w["moving_mean"].shape[0]
+        params = {"gamma": jnp.asarray(w.get("gamma", np.ones(c)), dt),
+                  "beta": jnp.asarray(w.get("beta", np.zeros(c)), dt)}
+        state = {"mean": jnp.asarray(w["moving_mean"], dt),
+                 "var": jnp.asarray(w["moving_variance"], dt)}
+        return params, state
+    if isinstance(layer, EmbeddingLayer):
+        emb = w["embeddings"]
+        return ({"W": jnp.asarray(emb, dt),
+                 "b": jnp.zeros((emb.shape[1],), dt)}, None)
+    if isinstance(layer, LSTM):
+        H = layer.n_out
+        return ({"W": jnp.asarray(_reorder_lstm(w["kernel"], H), dt),
+                 "RW": jnp.asarray(
+                     _reorder_lstm(w["recurrent_kernel"], H), dt),
+                 "b": jnp.asarray(
+                     _reorder_lstm(w.get("bias", np.zeros(4 * H)), H), dt)},
+                None)
+    return None, None
+
+
+# ------------------------------------------------------------- entry points
+
+class KerasModelImport:
+    """Entry points mirroring KerasModelImport.java:48-119."""
+
+    @staticmethod
+    def import_keras_sequential_model_and_weights(
+            path: str, enforce_training_config: bool = False
+    ) -> MultiLayerNetwork:
+        model_config, weights, training_config = _read_archive(path)
+        if model_config.get("class_name") != "Sequential":
+            raise KerasImportError(
+                f"{path} is not a Sequential model; use "
+                "import_keras_model_and_weights")
+        return _build_sequential(model_config, weights, training_config,
+                                 enforce_training_config)
+
+    @staticmethod
+    def import_keras_model_and_weights(
+            path: str, enforce_training_config: bool = False):
+        """Sequential -> MultiLayerNetwork; Functional -> ComputationGraph."""
+        model_config, weights, training_config = _read_archive(path)
+        if model_config.get("class_name") == "Sequential":
+            return _build_sequential(model_config, weights, training_config,
+                                     enforce_training_config)
+        return _build_functional(model_config, weights, training_config,
+                                 enforce_training_config)
+
+    @staticmethod
+    def import_keras_model_configuration(path: str):
+        """Configuration only, no weights (ref :119 overloads)."""
+        model_config, _, training_config = _read_archive(path)
+        if model_config.get("class_name") == "Sequential":
+            net = _build_sequential(model_config, {}, training_config, False)
+            return net.conf
+        net = _build_functional(model_config, {}, training_config, False)
+        return net.conf
+
+
+def _loss_from_training_config(training_config, enforce: bool):
+    loss = _map_loss(training_config.get("loss")) if training_config else None
+    if loss is None and enforce:
+        raise KerasImportError(
+            "no (supported) loss in training_config but "
+            "enforce_training_config=True")
+    return loss
+
+
+def _build_sequential(model_config, weights, training_config, enforce):
+    cfg = model_config.get("config")
+    layer_list = cfg["layers"] if isinstance(cfg, dict) else cfg
+    loss = _loss_from_training_config(training_config, enforce)
+
+    input_type = None
+    mapped: List[Tuple[Optional[str], Any]] = []   # (keras name, layer)
+    n_real = sum(1 for lc in layer_list
+                 if lc["class_name"] not in
+                 ("InputLayer", "Flatten", "Dropout", "Activation"))
+    seen_real = 0
+    for lc in layer_list:
+        cls = lc["class_name"]
+        c = lc.get("config", {})
+        if cls == "InputLayer":
+            shape = c.get("batch_shape") or c.get("batch_input_shape")
+            input_type = _input_type_from_shape(shape)
+            continue
+        if input_type is None and (
+                c.get("batch_input_shape") or c.get("batch_shape")):
+            input_type = _input_type_from_shape(
+                c.get("batch_input_shape") or c.get("batch_shape"))
+        if cls == "LSTM" and not c.get("return_sequences", False):
+            raise KerasImportError(
+                "LSTM with return_sequences=False has no MultiLayerNetwork "
+                "equivalent (needs last-time-step selection); import via "
+                "import_keras_model_and_weights on a functional model — "
+                "the importer maps it to a LastTimeStep vertex")
+        is_out = False
+        if cls not in ("Flatten", "Dropout", "Activation"):
+            seen_real += 1
+            is_out = seen_real == n_real and cls == "Dense"
+        layer = _map_layer(cls, c, is_output=is_out, loss=loss)
+        if layer == "flatten" or layer is None:
+            continue   # CnnToFF preprocessor is auto-inserted
+        mapped.append((c.get("name"), layer))
+
+    if input_type is None:
+        raise KerasImportError("could not determine the model input shape")
+
+    lb = (NeuralNetConfiguration.Builder().updater("sgd")
+          .learning_rate(1e-3).list())
+    for _, layer in mapped:
+        lb = lb.layer(layer)
+    conf = lb.set_input_type(input_type).build()
+    net = MultiLayerNetwork(conf).init()
+    _copy_weights_mln(net, mapped, weights)
+    return net
+
+
+def _copy_weights_mln(net, mapped, weights):
+    for i, (kname, layer) in enumerate(mapped):
+        w = weights.get(kname)
+        if not w:
+            continue
+        params, state = _params_from_keras(layer, w)
+        if params is not None:
+            _check_shapes(kname, net.params[i], params)
+            net.params[i] = params
+        if state is not None:
+            _check_shapes(kname, net.states[i], state)
+            net.states[i] = state
+
+
+def _check_shapes(name, have, want):
+    import jax
+
+    h = jax.tree_util.tree_map(lambda a: a.shape, have)
+    w = jax.tree_util.tree_map(lambda a: a.shape, want)
+    if h != w:
+        raise KerasImportError(
+            f"weight shape mismatch for layer '{name}': model expects {h}, "
+            f"HDF5 provides {w}")
+
+
+# ----------------------------------------------------------- functional API
+
+def _inbound_shapes(node) -> List[Optional[list]]:
+    """Collect tensor shapes attached to keras-3 inbound nodes (absent in
+    keras-2 configs)."""
+    out: List[Optional[list]] = []
+
+    def rec(v):
+        if isinstance(v, dict):
+            cfgd = v.get("config") if isinstance(v.get("config"), dict) \
+                else None
+            if cfgd and "keras_history" in cfgd:
+                out.append(cfgd.get("shape"))
+                return
+            for vv in v.values():
+                rec(vv)
+        elif isinstance(v, (list, tuple)):
+            for vv in v:
+                rec(vv)
+
+    rec(node)
+    return out
+
+
+def _inbound_names(node) -> List[str]:
+    """Parse inbound layer names from Keras 2 ([[name,0,0,{}],...]) or
+    Keras 3 ({'args': [... keras_history ...]}) node formats."""
+    out: List[str] = []
+
+    def rec(v):
+        if isinstance(v, dict):
+            if "keras_history" in v:
+                out.append(v["keras_history"][0])
+                return
+            kh = (v.get("config") or {}).get("keras_history")
+            if kh:
+                out.append(kh[0])
+                return
+            for vv in v.values():
+                rec(vv)
+        elif isinstance(v, (list, tuple)):
+            if (len(v) >= 3 and isinstance(v[0], str)
+                    and isinstance(v[1], int)):
+                out.append(v[0])
+                return
+            for vv in v:
+                rec(vv)
+
+    rec(node)
+    return out
+
+
+def _build_functional(model_config, weights, training_config, enforce):
+    cfg = model_config["config"]
+    layer_list = cfg["layers"]
+    loss = _loss_from_training_config(training_config, enforce)
+    # normalize: output_layers is [name,0,0] / [[name,0,0],...] / keras-3
+    # dicts — _inbound_names parses all three
+    out_names: List[str] = []
+    for n in _inbound_names(cfg.get("output_layers", [])):
+        if n not in out_names:
+            out_names.append(n)
+
+    gb = GraphBuilder(NeuralNetConfiguration.Builder()
+                      .updater("sgd").learning_rate(1e-3))
+    input_names: List[str] = []
+    input_types: List[InputType] = []
+    mapped: Dict[str, Any] = {}
+    for lc in layer_list:
+        cls = lc["class_name"]
+        c = lc.get("config", {})
+        name = c.get("name") or lc.get("name")
+        inbound = _inbound_names(lc.get("inbound_nodes", []))
+        # dedupe preserving order
+        seen = set()
+        inbound = [n for n in inbound
+                   if not (n in seen or seen.add(n))]
+        if cls == "InputLayer":
+            shape = c.get("batch_shape") or c.get("batch_input_shape")
+            input_names.append(name)
+            input_types.append(_input_type_from_shape(shape))
+            continue
+        def resolve(names):
+            return [mapped[n][1] if isinstance(mapped.get(n), tuple)
+                    and mapped[n][0] == "alias" else n for n in names]
+
+        if cls in _MERGE_CLASSES:
+            gb.add_vertex(name, ElementWiseVertex(op=_MERGE_CLASSES[cls]),
+                          *resolve(inbound))
+            continue
+        if cls == "Concatenate":
+            gb.add_vertex(name, MergeVertex(), *resolve(inbound))
+            continue
+        is_out = name in out_names and cls == "Dense"
+        layer = _map_layer(cls, c, is_output=is_out, loss=loss)
+        if layer == "flatten":
+            # with a known 4D input shape, Flatten is a real reshape node
+            # (a merge downstream must see the flattened vector); with an
+            # already-flat input it is transparent
+            shape4 = next((sh for sh in _inbound_shapes(
+                lc.get("inbound_nodes", [])) if sh and len(sh) == 4), None)
+            if shape4 is not None:
+                h, w, ch = (int(d) for d in shape4[1:])
+                gb.add_vertex(name, PreprocessorVertex(
+                    preprocessor=CnnToFeedForwardPreProcessor(
+                        height=h, width=w, channels=ch)),
+                    *resolve(inbound))
+            else:
+                mapped[name] = ("alias", resolve(inbound)[0])
+            continue
+        if layer is None:
+            mapped[name] = ("alias", resolve(inbound)[0])
+            continue
+        if cls == "LSTM" and not c.get("return_sequences", False):
+            # keras folds last-step selection into the layer; here it is
+            # an explicit LastTimeStep vertex named after the keras layer
+            seq_name = name + "__seq"
+            gb.add_layer(seq_name, layer, *resolve(inbound))
+            gb.add_vertex(name, LastTimeStepVertex(), seq_name)
+            mapped[name] = ("layer", layer, seq_name)
+            continue
+        gb.add_layer(name, layer, *resolve(inbound))
+        mapped[name] = ("layer", layer, name)
+
+    # resolve aliases in output names
+    outs = [mapped[n][1] if isinstance(mapped.get(n), tuple)
+            and mapped[n][0] == "alias" else n for n in out_names]
+    gb.add_inputs(*input_names)
+    gb.set_outputs(*outs)
+    gb.set_input_types(**dict(zip(input_names, input_types)))
+    conf = gb.build()
+    net = ComputationGraph(conf).init()
+    for name, entry in mapped.items():
+        if entry[0] != "layer":
+            continue
+        node_name = entry[2]
+        w = weights.get(name)
+        if not w:
+            continue
+        params, state = _params_from_keras(entry[1], w)
+        if params is not None:
+            _check_shapes(name, net.params[node_name], params)
+            net.params[node_name] = params
+        if state is not None:
+            _check_shapes(name, net.states[node_name], state)
+            net.states[node_name] = state
+    return net

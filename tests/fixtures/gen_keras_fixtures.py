@@ -1,0 +1,107 @@
+"""Generate the committed Keras HDF5 import fixtures + expected outputs.
+
+Run from the repo root (writes into tests/fixtures/):
+    python tests/fixtures/gen_keras_fixtures.py
+
+The .h5 files and *_expected.npz oracles are committed so the test suite
+never needs TensorFlow (ref test strategy: modelimport golden-file
+fixtures, SURVEY §4 "Keras import tests").
+"""
+
+import os
+import sys
+
+os.environ["CUDA_VISIBLE_DEVICES"] = ""
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def main():
+    from tensorflow import keras
+    from tensorflow.keras import layers
+
+    rng = np.random.default_rng(42)
+
+    # 1. Sequential CNN: conv/pool/BN/flatten/dense/dropout/softmax head
+    m = keras.Sequential([
+        keras.Input((8, 8, 3)),
+        layers.Conv2D(4, 3, activation="relu", name="c1"),
+        layers.MaxPooling2D(2, name="p1"),
+        layers.BatchNormalization(name="bn1"),
+        layers.Flatten(name="f1"),
+        layers.Dense(16, activation="tanh", name="h1"),
+        layers.Dropout(0.25, name="do1"),
+        layers.Dense(10, activation="softmax", name="d1"),
+    ])
+    m.compile(loss="categorical_crossentropy", optimizer="sgd")
+    # non-trivial BN moving stats
+    m.layers[2].set_weights([
+        rng.normal(1.0, 0.1, 4).astype(np.float32),   # gamma
+        rng.normal(0.0, 0.1, 4).astype(np.float32),   # beta
+        rng.normal(0.0, 0.5, 4).astype(np.float32),   # moving_mean
+        rng.uniform(0.5, 2.0, 4).astype(np.float32),  # moving_variance
+    ])
+    x = rng.normal(size=(5, 8, 8, 3)).astype(np.float32)
+    m.save(os.path.join(HERE, "seq_cnn.h5"))
+    np.savez(os.path.join(HERE, "seq_cnn_expected.npz"),
+             x=x, y=m.predict(x, verbose=0))
+
+    # 2. Functional two-branch with Add + Concatenate merges
+    inp = keras.Input((6,), name="in0")
+    a = layers.Dense(8, activation="relu", name="fa")(inp)
+    b = layers.Dense(8, activation="tanh", name="fb")(inp)
+    s = layers.Add(name="sum")([a, b])
+    c = layers.Concatenate(name="cat")([s, inp])
+    out = layers.Dense(3, activation="softmax", name="out")(c)
+    fm = keras.Model(inp, out)
+    fm.compile(loss="categorical_crossentropy", optimizer="sgd")
+    xf = rng.normal(size=(7, 6)).astype(np.float32)
+    fm.save(os.path.join(HERE, "func_merge.h5"))
+    np.savez(os.path.join(HERE, "func_merge_expected.npz"),
+             x=xf, y=fm.predict(xf, verbose=0))
+
+    # 3. LSTM stack (return_sequences) — exercises gate-order remapping
+    lm = keras.Sequential([
+        keras.Input((5, 4)),
+        layers.LSTM(6, return_sequences=True, name="l1"),
+        layers.LSTM(3, return_sequences=True, name="l2"),
+    ])
+    xl = rng.normal(size=(2, 5, 4)).astype(np.float32)
+    lm.save(os.path.join(HERE, "lstm_seq.h5"))
+    np.savez(os.path.join(HERE, "lstm_seq_expected.npz"),
+             x=xl, y=lm.predict(xl, verbose=0))
+
+    # 4. Functional CNN: two conv branches -> Flatten each -> Concatenate
+    #    (merge consuming Flatten aliases) -> Dense head
+    ci = keras.Input((8, 8, 3), name="img")
+    b1 = layers.Conv2D(3, 3, activation="relu", name="cb1")(ci)
+    b2 = layers.Conv2D(2, 5, activation="tanh", name="cb2")(ci)
+    f1 = layers.Flatten(name="fl1")(b1)
+    f2 = layers.Flatten(name="fl2")(b2)
+    cc = layers.Concatenate(name="cat2")([f1, f2])
+    o2 = layers.Dense(4, activation="softmax", name="out2")(cc)
+    cm = keras.Model(ci, o2)
+    cm.compile(loss="categorical_crossentropy", optimizer="sgd")
+    xc = rng.normal(size=(3, 8, 8, 3)).astype(np.float32)
+    cm.save(os.path.join(HERE, "func_cnn_merge.h5"))
+    np.savez(os.path.join(HERE, "func_cnn_merge_expected.npz"),
+             x=xc, y=cm.predict(xc, verbose=0))
+
+    # 5. LSTM encoder: return_sequences=False -> LastTimeStep vertex
+    ei = keras.Input((5, 4), name="seq")
+    eh = layers.LSTM(6, return_sequences=False, name="enc")(ei)
+    eo = layers.Dense(3, activation="softmax", name="head")(eh)
+    em = keras.Model(ei, eo)
+    em.compile(loss="categorical_crossentropy", optimizer="sgd")
+    xe = rng.normal(size=(3, 5, 4)).astype(np.float32)
+    em.save(os.path.join(HERE, "lstm_encoder.h5"))
+    np.savez(os.path.join(HERE, "lstm_encoder_expected.npz"),
+             x=xe, y=em.predict(xe, verbose=0))
+
+    print("fixtures written to", HERE)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
