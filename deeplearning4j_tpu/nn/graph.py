@@ -12,6 +12,7 @@ program, gradients via jax.grad over the summed multi-output loss.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -326,7 +327,15 @@ class ComputationGraph:
                     listener.on_epoch_start(self)
             if hasattr(batches, "reset"):
                 batches.reset()
-            for batch in batches:
+            _it = iter(batches)
+            while True:
+                # ETL bookkeeping (ref: MLN.fit lastEtlTime :1108-1113)
+                _t0 = time.perf_counter()
+                try:
+                    batch = next(_it)
+                except StopIteration:
+                    break
+                self._last_etl_ms = (time.perf_counter() - _t0) * 1e3
                 ins, labs, fms, lms = _as_multi(batch)
                 self._fit_one(ins, labs, fms, lms)
                 for listener in self.listeners:

@@ -20,6 +20,7 @@ TPU-native design:
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -346,7 +347,16 @@ class MultiLayerNetwork:
                     listener.on_epoch_start(self)
             if hasattr(batches, "reset"):
                 batches.reset()
-            for batch in batches:
+            _it = iter(batches)
+            while True:
+                # ETL bookkeeping (ref: MLN.fit lastEtlTime :1108-1113):
+                # time spent waiting on the data pipeline for this batch
+                _t0 = time.perf_counter()
+                try:
+                    batch = next(_it)
+                except StopIteration:
+                    break
+                self._last_etl_ms = (time.perf_counter() - _t0) * 1e3
                 x, y, fm, lm = _as_batch(batch)
                 x = jnp.asarray(x, self.dtype)
                 y = jnp.asarray(y, self.dtype)

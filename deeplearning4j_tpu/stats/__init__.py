@@ -1,0 +1,11 @@
+from deeplearning4j_tpu.stats.report import StatsReport  # noqa: F401
+from deeplearning4j_tpu.stats.storage import (  # noqa: F401
+    FileStatsStorage,
+    InMemoryStatsStorage,
+    StatsStorage,
+)
+from deeplearning4j_tpu.stats.listener import StatsListener  # noqa: F401
+from deeplearning4j_tpu.stats.dashboard import (  # noqa: F401
+    UIServer,
+    render_html,
+)

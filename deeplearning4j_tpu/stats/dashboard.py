@@ -1,0 +1,198 @@
+"""Training dashboard: static HTML export + minimal HTTP server.
+
+Parity: the reference's Play UI train module (ui/play/PlayUIServer.java,
+ui/module/train/TrainModule.java — score chart, mean-magnitude
+timelines, histograms, system tab). TPU-native difference: a
+dependency-free self-contained HTML file (inline SVG charts, data
+embedded as JSON) — no Play framework, no websockets; the UIServer
+re-renders on each GET, which at listener frequencies is milliseconds.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import threading
+from typing import Optional
+
+from deeplearning4j_tpu.stats.storage import StatsStorage
+
+_PAGE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>deeplearning4j_tpu — training</title>
+<style>
+ body {{ font-family: system-ui, sans-serif; margin: 24px; color: #222; }}
+ h1 {{ font-size: 20px; }} h2 {{ font-size: 16px; margin-top: 28px; }}
+ .meta {{ color: #666; font-size: 13px; }}
+ .row {{ display: flex; flex-wrap: wrap; gap: 24px; }}
+ .chart {{ border: 1px solid #ddd; border-radius: 6px; padding: 8px; }}
+ .lbl {{ font-size: 12px; color: #555; text-anchor: middle; }}
+</style></head>
+<body>
+<h1>Training session <code>{session}</code></h1>
+<p class="meta">{n} reports · final score {final_score} ·
+ {sps} samples/sec · ETL {etl} ms · device mem {dev_mem} MB</p>
+<div id="charts" class="row"></div>
+<h2>Parameter mean magnitudes (log10)</h2>
+<div id="pmm" class="row"></div>
+<h2>Update mean magnitudes (log10)</h2>
+<div id="umm" class="row"></div>
+<h2>Latest parameter histograms</h2>
+<div id="hists" class="row"></div>
+<script>
+const DATA = {data};
+function svgLine(pts, w, h, color) {{
+  if (pts.length === 0) return '';
+  const xs = pts.map(p => p[0]), ys = pts.map(p => p[1]);
+  const x0 = Math.min(...xs), x1 = Math.max(...xs);
+  const y0 = Math.min(...ys), y1 = Math.max(...ys);
+  const sx = v => 40 + (w - 50) * (x1 === x0 ? 0 : (v - x0) / (x1 - x0));
+  const sy = v => (h - 20) - (h - 35) * (y1 === y0 ? 0.5 : (v - y0) / (y1 - y0));
+  const d = pts.map((p, i) => (i ? 'L' : 'M') + sx(p[0]).toFixed(1) + ' ' + sy(p[1]).toFixed(1)).join(' ');
+  return `<path d="${{d}}" fill="none" stroke="${{color}}" stroke-width="1.5"/>` +
+    `<text class="lbl" x="8" y="18" text-anchor="start">${{y1.toPrecision(4)}}</text>` +
+    `<text class="lbl" x="8" y="${{h - 22}}" text-anchor="start">${{y0.toPrecision(4)}}</text>`;
+}}
+function chart(title, pts, color) {{
+  const w = 420, h = 180;
+  return `<div class="chart"><svg width="${{w}}" height="${{h}}">` +
+    svgLine(pts, w, h, color) +
+    `<text class="lbl" x="${{w / 2}}" y="${{h - 4}}">${{title}}</text></svg></div>`;
+}}
+function bars(title, hist) {{
+  const w = 320, h = 140, n = hist.counts.length;
+  const m = Math.max(...hist.counts, 1);
+  let rects = '';
+  for (let i = 0; i < n; i++) {{
+    const bh = (h - 30) * hist.counts[i] / m;
+    rects += `<rect x="${{5 + i * (w - 10) / n}}" y="${{h - 22 - bh}}"` +
+      ` width="${{(w - 10) / n - 1}}" height="${{bh}}" fill="#4a7fb5"/>`;
+  }}
+  return `<div class="chart"><svg width="${{w}}" height="${{h}}">` + rects +
+    `<text class="lbl" x="${{w / 2}}" y="${{h - 8}}">${{title}}` +
+    ` [${{hist.min.toPrecision(3)}}, ${{hist.max.toPrecision(3)}}]</text></svg></div>`;
+}}
+const reps = DATA.reports;
+const iters = reps.map(r => r.iteration);
+const sc = reps.filter(r => r.score != null).map(r => [r.iteration, r.score]);
+document.getElementById('charts').innerHTML =
+  chart('score vs iteration', sc, '#c0392b') +
+  chart('samples/sec', reps.filter(r => r.samples_per_sec != null)
+        .map(r => [r.iteration, r.samples_per_sec]), '#27ae60') +
+  chart('ETL ms', reps.filter(r => r.etl_ms != null)
+        .map(r => [r.iteration, r.etl_ms]), '#8e44ad');
+function mmCharts(el, key) {{
+  const names = new Set();
+  reps.forEach(r => Object.keys(r[key] || {{}}).forEach(k => names.add(k)));
+  let htmlStr = '';
+  for (const name of Array.from(names).slice(0, 24)) {{
+    const pts = reps.filter(r => (r[key] || {{}})[name] > 0)
+      .map(r => [r.iteration, Math.log10(r[key][name])]);
+    htmlStr += chart(name, pts, '#2c6fad');
+  }}
+  document.getElementById(el).innerHTML = htmlStr || '<p class="meta">none collected</p>';
+}}
+mmCharts('pmm', 'param_mean_magnitudes');
+mmCharts('umm', 'update_mean_magnitudes');
+const last = reps[reps.length - 1] || {{}};
+let hh = '';
+for (const [name, hist] of Object.entries(last.param_histograms || {{}}).slice(0, 24))
+  hh += bars(name, hist);
+document.getElementById('hists').innerHTML = hh || '<p class="meta">none collected</p>';
+</script>
+</body></html>
+"""
+
+
+def render_html(storage: StatsStorage, session_id: Optional[str] = None,
+                path: Optional[str] = None) -> str:
+    """Render a self-contained HTML report; write to `path` if given.
+    Defaults to the storage's only (or first) session."""
+    sessions = storage.session_ids()
+    if not sessions:
+        raise ValueError("storage has no sessions")
+    if session_id is None:
+        session_id = sessions[0]
+    reports = storage.reports(session_id)
+    latest = reports[-1] if reports else None
+    fmt = lambda v, nd=1: "–" if v is None else f"{v:.{nd}f}"
+    page = _PAGE.format(
+        session=html.escape(session_id),
+        n=len(reports),
+        final_score="–" if latest is None or latest.score is None
+        else f"{latest.score:.4f}",
+        sps=fmt(latest.samples_per_sec if latest else None),
+        etl=fmt(latest.etl_ms if latest else None, 2),
+        dev_mem=fmt((latest.mem or {}).get("device_in_use_mb")
+                    if latest else None),
+        data=json.dumps({"reports": [r.to_dict() for r in reports]}),
+    )
+    if path:
+        with open(path, "w") as f:
+            f.write(page)
+    return page
+
+
+class UIServer:
+    """Minimal HTTP dashboard (ref: UIServer.getInstance().attach(storage),
+    ui/api/UIServer.java:24,42). Serves the rendered report at / and
+    per-session at /session/<id>; re-renders per request."""
+
+    def __init__(self, port: int = 9000, host: str = "127.0.0.1"):
+        self.host = host
+        self.port = port
+        self._storage: Optional[StatsStorage] = None
+        self._httpd = None
+        self._thread = None
+
+    def attach(self, storage: StatsStorage) -> "UIServer":
+        self._storage = storage
+        return self
+
+    def start(self) -> "UIServer":
+        import http.server
+
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                try:
+                    if server._storage is None:
+                        raise ValueError("no storage attached")
+                    sid = None
+                    if self.path.startswith("/session/"):
+                        sid = self.path.split("/session/", 1)[1] or None
+                    body = render_html(server._storage, sid).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/html; charset=utf-8")
+                except Exception as e:  # pragma: no cover - error path
+                    body = f"<html><body>{html.escape(str(e))}" \
+                           f"</body></html>".encode()
+                    self.send_response(503)
+                    self.send_header("Content-Type",
+                                     "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        import socketserver
+
+        class _Server(socketserver.ThreadingMixIn, http.server.HTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._httpd = _Server((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -1,0 +1,187 @@
+"""StatsListener: collects training telemetry into a StatsStorage.
+
+Parity: ui/stats/BaseStatsListener.java:106 — score, throughput, ETL
+time, memory, and histograms + mean magnitudes of parameters and
+updates, sampled every `frequency` iterations.
+
+TPU-native design: summaries are computed ON DEVICE by one jitted
+reduction program (per-group histogram counts + mean |x|), so only
+tiny arrays cross the host boundary, and only on collection
+iterations — the train step itself is untouched. "Updates" are the
+parameter deltas across the collection window (the reference records
+per-iteration updater output; the window delta is the same signal
+sampled at the listener's own frequency, without forcing the step to
+emit 100MB of per-iteration gradients). Gradient histograms are
+intentionally not collected for that reason.
+"""
+
+from __future__ import annotations
+
+import resource
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+from deeplearning4j_tpu.stats.report import Histogram, StatsReport
+from deeplearning4j_tpu.stats.storage import StatsStorage
+
+
+def jnp_array(a):
+    import jax.numpy as jnp
+
+    return jnp.array(a)
+
+
+def _named_leaves(params):
+    """Flatten params into [(group_name, leaf), ...] with stable names
+    like '0/W' (list container) or 'conv1/gamma' (dict container)."""
+    import jax
+
+    out = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        parts = []
+        for p in path:
+            if hasattr(p, "key"):
+                parts.append(str(p.key))
+            elif hasattr(p, "idx"):
+                parts.append(str(p.idx))
+            else:
+                parts.append(str(p))
+        out.append(("/".join(parts), leaf))
+    return out
+
+
+class StatsListener:
+    """Attach with `net.listeners.append(StatsListener(storage))`.
+
+    collect_histograms/collect_updates mirror the reference's
+    DefaultStatsUpdateConfiguration toggles."""
+
+    def __init__(self, storage: StatsStorage, frequency: int = 10,
+                 session_id: Optional[str] = None,
+                 worker_id: str = "local",
+                 collect_histograms: bool = True,
+                 collect_updates: bool = True,
+                 num_bins: int = 32):
+        self.storage = storage
+        self.frequency = max(1, frequency)
+        self.session_id = session_id or f"session-{uuid.uuid4().hex[:8]}"
+        self.worker_id = worker_id
+        self.collect_histograms = collect_histograms
+        self.collect_updates = collect_updates
+        self.num_bins = num_bins
+        self._stats_fn = None
+        self._prev_params = None
+        self._last_time = None
+        self._last_iter = None
+
+    # ------------------------------------------------------------ device side
+    def _build_stats_fn(self):
+        import jax
+        import jax.numpy as jnp
+
+        bins = self.num_bins
+
+        def summarize(tree):
+            out = {}
+            for name, leaf in _named_leaves(tree):
+                x = leaf.reshape(-1).astype(jnp.float32)
+                lo = jnp.min(x)
+                hi = jnp.max(x)
+                counts, _ = jnp.histogram(x, bins=bins, range=None)
+                out[name] = (lo, hi, counts, jnp.mean(jnp.abs(x)))
+            return out
+
+        def fn(params, prev):
+            res = {"params": summarize(params)}
+            if prev is not None:
+                delta = jax.tree_util.tree_map(
+                    lambda a, b: a - b, params, prev)
+                res["updates"] = summarize(delta)
+            return res
+
+        return jax.jit(fn, static_argnames=())
+
+    def _collect_summaries(self, net) -> Dict[str, Any]:
+        import jax
+
+        if self._stats_fn is None:
+            self._stats_fn = self._build_stats_fn()
+        prev = self._prev_params if self.collect_updates else None
+        res = self._stats_fn(net.params, prev)
+        out = {}
+        for kind, groups in res.items():
+            hists = {}
+            means = {}
+            for name, (lo, hi, counts, mean_abs) in groups.items():
+                means[name] = float(mean_abs)
+                if self.collect_histograms:
+                    hists[name] = Histogram(
+                        min=float(lo), max=float(hi),
+                        counts=[int(c) for c in counts])
+            out[kind] = (means, hists)
+        if self.collect_updates:
+            # deep copy: the train step donates its param buffers, so a
+            # bare reference would be deleted by the next step
+            self._prev_params = jax.tree_util.tree_map(
+                jnp_array, net.params)
+        return out
+
+    # -------------------------------------------------------------- listener
+    def iteration_done(self, model, iteration: int):
+        now = time.perf_counter()
+        if self._last_time is None:
+            self._last_time = now
+            self._last_iter = iteration
+            # baseline snapshot so the first collected window has updates
+            if self.collect_updates and model.params is not None:
+                import jax
+                self._prev_params = jax.tree_util.tree_map(
+                    jnp_array, model.params)
+            return
+        if iteration % self.frequency != 0:
+            return
+
+        dt = now - self._last_time
+        n = max(iteration - self._last_iter, 1)
+        batches_per_sec = n / dt if dt > 0 else None
+        batch = getattr(model, "_last_batch_size", None)
+        report = StatsReport(
+            session_id=self.session_id,
+            worker_id=self.worker_id,
+            iteration=iteration,
+            epoch=getattr(model, "epoch", 0),
+            score=None if model.score() is None else float(model.score()),
+            batches_per_sec=batches_per_sec,
+            samples_per_sec=(batches_per_sec * batch
+                             if batches_per_sec and batch else None),
+            iter_ms=dt / n * 1e3,
+            etl_ms=getattr(model, "_last_etl_ms", None),
+            mem=self._memory(),
+        )
+        summaries = self._collect_summaries(model)
+        report.param_mean_magnitudes, report.param_histograms = \
+            summaries["params"]
+        if "updates" in summaries:
+            (report.update_mean_magnitudes,
+             report.update_histograms) = summaries["updates"]
+        self.storage.put_report(report)
+        self._last_time = time.perf_counter()
+        self._last_iter = iteration
+
+    @staticmethod
+    def _memory() -> Dict[str, Any]:
+        mem = {"host_rss_mb": resource.getrusage(
+            resource.RUSAGE_SELF).ru_maxrss / 1024.0}
+        try:
+            import jax
+
+            st = jax.devices()[0].memory_stats()
+            if st:
+                mem["device_in_use_mb"] = st.get(
+                    "bytes_in_use", 0) / 1e6
+                mem["device_limit_mb"] = st.get(
+                    "bytes_limit", 0) / 1e6
+        except Exception:
+            pass
+        return mem

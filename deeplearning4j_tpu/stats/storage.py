@@ -1,0 +1,103 @@
+"""Stats storage SPI + in-memory and file backends.
+
+Parity: api/storage/StatsStorage.java (SPI shared by UI & Spark),
+ui/storage/InMemoryStatsStorage.java:21, FileStatsStorage.java /
+MapDBStatsStorage.java:22 (persistent). The file backend is append-only
+JSONL — durable, tail-able, and diff-friendly; MapDB is a JVM-ism."""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Dict, List, Optional
+
+from deeplearning4j_tpu.stats.report import StatsReport
+
+
+class StatsStorage:
+    """SPI: put/list/get reports + change listeners
+    (ref: StatsStorage.java / StatsStorageRouter.java)."""
+
+    def put_report(self, report: StatsReport) -> None:
+        raise NotImplementedError
+
+    def session_ids(self) -> List[str]:
+        raise NotImplementedError
+
+    def reports(self, session_id: str) -> List[StatsReport]:
+        raise NotImplementedError
+
+    def latest(self, session_id: str) -> Optional[StatsReport]:
+        rs = self.reports(session_id)
+        return rs[-1] if rs else None
+
+    def add_listener(self, fn: Callable[[StatsReport], None]) -> None:
+        self._listeners().append(fn)
+
+    def _listeners(self) -> list:
+        if not hasattr(self, "_cbs"):
+            self._cbs = []
+        return self._cbs
+
+    def _notify(self, report: StatsReport) -> None:
+        for fn in self._listeners():
+            fn(report)
+
+    def close(self) -> None:
+        pass
+
+
+class InMemoryStatsStorage(StatsStorage):
+    """ref: InMemoryStatsStorage.java:21."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._by_session: Dict[str, List[StatsReport]] = {}
+
+    def put_report(self, report: StatsReport) -> None:
+        with self._lock:
+            self._by_session.setdefault(report.session_id, []).append(report)
+        self._notify(report)
+
+    def session_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._by_session)
+
+    def reports(self, session_id: str) -> List[StatsReport]:
+        with self._lock:
+            return list(self._by_session.get(session_id, []))
+
+
+class FileStatsStorage(StatsStorage):
+    """Append-only JSONL file storage (ref: FileStatsStorage.java /
+    MapDBStatsStorage.java:22 persistent role). Reopening the same path
+    loads previously recorded reports."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._mem = InMemoryStatsStorage()
+        if os.path.exists(path):
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        self._mem.put_report(StatsReport.from_json(line))
+        self._fh = open(path, "a")
+
+    def put_report(self, report: StatsReport) -> None:
+        with self._lock:
+            self._fh.write(report.to_json() + "\n")
+            self._fh.flush()
+        self._mem.put_report(report)
+        self._notify(report)
+
+    def session_ids(self) -> List[str]:
+        return self._mem.session_ids()
+
+    def reports(self, session_id: str) -> List[StatsReport]:
+        return self._mem.reports(session_id)
+
+    def close(self) -> None:
+        with self._lock:
+            self._fh.close()
