@@ -1,0 +1,12 @@
+from deeplearning4j_tpu.clustering.distances import (  # noqa: F401
+    knn,
+    pairwise_distance,
+)
+from deeplearning4j_tpu.clustering.vptree import VPTree  # noqa: F401
+from deeplearning4j_tpu.clustering.kdtree import KDTree  # noqa: F401
+from deeplearning4j_tpu.clustering.kmeans import (  # noqa: F401
+    Cluster,
+    ClusterSet,
+    KMeansClustering,
+)
+from deeplearning4j_tpu.clustering.tsne import Tsne  # noqa: F401

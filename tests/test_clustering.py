@@ -1,0 +1,128 @@
+"""Clustering/NN/t-SNE tests (ref: nearestneighbor-core test suites +
+BarnesHutTsne tests — small-fixture semantic checks)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (
+    KDTree,
+    KMeansClustering,
+    Tsne,
+    VPTree,
+    knn,
+    pairwise_distance,
+)
+
+
+def _blobs(rng, n_per=30, d=5, centers=((0,) * 5, (8,) * 5, (-8, 8, -8, 8, -8))):
+    xs, labels = [], []
+    for i, c in enumerate(centers):
+        xs.append(rng.normal(size=(n_per, d)) + np.asarray(c))
+        labels += [i] * n_per
+    return np.concatenate(xs).astype(np.float32), np.asarray(labels)
+
+
+def test_pairwise_distance_oracle(rng):
+    x = rng.normal(size=(7, 4))
+    y = rng.normal(size=(5, 4))
+    d = np.asarray(pairwise_distance(x, y, "euclidean"))
+    brute = np.sqrt(((x[:, None, :] - y[None, :, :]) ** 2).sum(-1))
+    np.testing.assert_allclose(d, brute, rtol=1e-4, atol=1e-5)
+    d1 = np.asarray(pairwise_distance(x, y, "manhattan"))
+    np.testing.assert_allclose(
+        d1, np.abs(x[:, None, :] - y[None, :, :]).sum(-1), rtol=1e-5)
+    dc = np.asarray(pairwise_distance(x, y, "cosine"))
+    xn = x / np.linalg.norm(x, axis=1, keepdims=True)
+    yn = y / np.linalg.norm(y, axis=1, keepdims=True)
+    np.testing.assert_allclose(dc, 1 - xn @ yn.T, rtol=1e-4, atol=1e-5)
+
+
+def test_knn_device_matches_brute(rng):
+    corpus = rng.normal(size=(200, 8)).astype(np.float32)
+    queries = rng.normal(size=(11, 8)).astype(np.float32)
+    idx, dist = knn(queries, corpus, k=5)
+    brute = np.sqrt(((queries[:, None, :] - corpus[None]) ** 2).sum(-1))
+    expect = np.argsort(brute, axis=1)[:, :5]
+    np.testing.assert_array_equal(idx, expect)
+    np.testing.assert_allclose(dist, np.sort(brute, axis=1)[:, :5],
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_vptree_exact(rng):
+    pts = rng.normal(size=(120, 6))
+    tree = VPTree(pts, metric="euclidean")
+    q = rng.normal(size=(6,))
+    idx, dist = tree.search(q, k=7)
+    brute = np.linalg.norm(pts - q, axis=1)
+    np.testing.assert_array_equal(idx, np.argsort(brute)[:7])
+    np.testing.assert_allclose(dist, np.sort(brute)[:7], rtol=1e-9)
+
+
+def test_vptree_other_metrics(rng):
+    pts = rng.normal(size=(60, 4))
+    q = rng.normal(size=(4,))
+    for metric, fn in [
+        ("manhattan", lambda a: np.abs(pts - a).sum(1)),
+        ("cosine", lambda a: 1 - (pts @ a) /
+         (np.linalg.norm(pts, axis=1) * np.linalg.norm(a))),
+    ]:
+        tree = VPTree(pts, metric=metric)
+        idx, _ = tree.search(q, k=3)
+        np.testing.assert_array_equal(idx, np.argsort(fn(q))[:3])
+
+
+def test_kdtree_matches_brute(rng):
+    pts = rng.normal(size=(100, 3))
+    tree = KDTree(3)
+    for p in pts:
+        tree.insert(p)
+    q = rng.normal(size=(3,))
+    idx, dist = tree.knn(q, k=4)
+    brute = np.linalg.norm(pts - q, axis=1)
+    np.testing.assert_array_equal(idx, np.argsort(brute)[:4])
+    i0, d0 = tree.nn(q)
+    assert i0 == int(np.argmin(brute))
+    assert d0 == pytest.approx(float(np.min(brute)))
+
+
+def test_kmeans_recovers_blobs(rng):
+    x, labels = _blobs(rng)
+    cs = KMeansClustering.setup(3, max_iterations=50).apply(x)
+    assert len(cs.clusters) == 3
+    # purity: every true blob maps to one dominant cluster
+    for i in range(3):
+        assign = cs.assignments[labels == i]
+        dominant = np.bincount(assign, minlength=3).max()
+        assert dominant / len(assign) > 0.95
+    # centroids near blob means
+    means = np.stack([x[labels == i].mean(0) for i in range(3)])
+    d = np.asarray(pairwise_distance(means, cs.centers))
+    assert float(d.min(axis=1).max()) < 1.0
+    assert np.isfinite(cs.inertia)
+
+
+def test_kmeans_too_few_points():
+    with pytest.raises(ValueError, match="k=5"):
+        KMeansClustering(5).apply(np.zeros((3, 2), np.float32))
+
+
+def test_tsne_separates_clusters(rng):
+    x, labels = _blobs(rng, n_per=25, d=10,
+                       centers=((0,) * 10, (10,) * 10, (-10, 10) * 5))
+    emb = Tsne(perplexity=10.0, max_iter=300, seed=1).fit_transform(x)
+    assert emb.shape == (75, 2)
+    assert np.isfinite(emb).all()
+    # intra-cluster distances should be far smaller than inter-cluster
+    intra, inter = [], []
+    for i in range(3):
+        pts = emb[labels == i]
+        intra.append(np.linalg.norm(pts - pts.mean(0), axis=1).mean())
+        for j in range(i + 1, 3):
+            inter.append(np.linalg.norm(
+                pts.mean(0) - emb[labels == j].mean(0)))
+    assert max(intra) * 2.0 < min(inter)
+
+
+def test_tsne_perplexity_guard():
+    with pytest.raises(ValueError, match="perplexity"):
+        Tsne(perplexity=30.0).fit_transform(np.zeros((10, 3), np.float32))
