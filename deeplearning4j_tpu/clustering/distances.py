@@ -20,6 +20,13 @@ import numpy as np
 _METRICS = ("euclidean", "sqeuclidean", "manhattan", "cosine", "dot")
 
 
+@partial(jax.jit, static_argnames=("metric", "k"))
+def _knn_block(q, c, metric, k):
+    d = pairwise_distance(q, c, metric)
+    neg, idx = jax.lax.top_k(-d, k)
+    return idx, -neg
+
+
 @partial(jax.jit, static_argnames=("metric",))
 def pairwise_distance(x, y, metric: str = "euclidean"):
     """[N,D] x [M,D] -> [N,M] distances."""
@@ -52,17 +59,10 @@ def knn(queries, corpus, k: int, metric: str = "euclidean",
     queries = np.asarray(queries)
     corpus = jnp.asarray(corpus)
     k = min(k, corpus.shape[0])
-
-    @partial(jax.jit, static_argnames=("metric", "k"))
-    def block(q, c, metric, k):
-        d = pairwise_distance(q, c, metric)
-        neg, idx = jax.lax.top_k(-d, k)
-        return idx, -neg
-
     out_i, out_d = [], []
     for s in range(0, queries.shape[0], tile):
         q = jnp.asarray(queries[s:s + tile])
-        idx, dist = block(q, corpus, metric, k)
+        idx, dist = _knn_block(q, corpus, metric, k)
         out_i.append(np.asarray(idx))
         out_d.append(np.asarray(dist))
     return np.concatenate(out_i), np.concatenate(out_d)

@@ -64,9 +64,18 @@ class KDTree:
         heap: list = []
         k = min(k, self.size)
 
-        def visit(node, depth):
+        # explicit stack (insertion-order trees can be N deep; Python
+        # recursion would overflow on sorted inserts)
+        stack = [(self.root, 0, False)]
+        while stack:
+            node, depth, is_far = stack.pop()
             if node is None:
-                return
+                continue
+            if is_far:
+                # deferred far-side: re-check the prune radius now
+                _, parent_diff = is_far
+                if len(heap) == k and abs(parent_diff) >= -heap[0][0]:
+                    continue
             d = float(np.linalg.norm(query - node.point))
             if len(heap) < k:
                 heapq.heappush(heap, (-d, node.index))
@@ -76,11 +85,9 @@ class KDTree:
             diff = query[axis] - node.point[axis]
             near, far = ((node.left, node.right) if diff < 0
                          else (node.right, node.left))
-            visit(near, depth + 1)
-            if len(heap) < k or abs(diff) < -heap[0][0]:
-                visit(far, depth + 1)
-
-        visit(self.root, 0)
+            # LIFO: push far first so near is fully explored before far
+            stack.append((far, depth + 1, (True, diff)))
+            stack.append((near, depth + 1, False))
         pairs = sorted((-nd, i) for nd, i in heap)
         return ([i for _, i in pairs], [d for d, _ in pairs])
 

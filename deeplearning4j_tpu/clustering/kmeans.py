@@ -81,8 +81,13 @@ class KMeansClustering:
             d2 = np.min(
                 np.asarray(pairwise_distance(
                     points, np.stack(centers), "sqeuclidean")), axis=1)
-            probs = d2 / max(d2.sum(), 1e-12)
-            centers.append(points[rng.choice(n, p=probs)])
+            total = float(d2.sum())
+            if total <= 1e-12:
+                # all remaining points coincide with a chosen center
+                # (duplicates): fall back to uniform choice
+                centers.append(points[rng.integers(n)])
+                continue
+            centers.append(points[rng.choice(n, p=d2 / total)])
         return np.stack(centers)
 
     def apply(self, points) -> ClusterSet:

@@ -22,3 +22,12 @@ from deeplearning4j_tpu.datasets.normalizers import (  # noqa: F401
     NormalizerMinMaxScaler,
     NormalizerStandardize,
 )
+from deeplearning4j_tpu.datasets.records import (  # noqa: F401
+    CSVRecordReader,
+    CSVSequenceRecordReader,
+    CollectionRecordReader,
+    CollectionSequenceRecordReader,
+    RecordReaderDataSetIterator,
+    RecordReaderMultiDataSetIterator,
+    SequenceRecordReaderDataSetIterator,
+)

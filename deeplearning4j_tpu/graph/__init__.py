@@ -1,0 +1,12 @@
+from deeplearning4j_tpu.graph.graph import Graph, Vertex, Edge  # noqa: F401
+from deeplearning4j_tpu.graph.loader import (  # noqa: F401
+    load_delimited_edge_list,
+    load_weighted_edge_list,
+)
+from deeplearning4j_tpu.graph.walks import (  # noqa: F401
+    Node2VecWalkIterator,
+    RandomWalkIterator,
+    WeightedRandomWalkIterator,
+)
+from deeplearning4j_tpu.graph.deepwalk import DeepWalk  # noqa: F401
+from deeplearning4j_tpu.graph.node2vec import Node2Vec  # noqa: F401

@@ -13,6 +13,8 @@ synchronously.
 from deeplearning4j_tpu.nlp.tokenization import (  # noqa: F401
     CommonPreprocessor,
     DefaultTokenizerFactory,
+    StopWords,
+    StopWordsPreProcessor,
 )
 from deeplearning4j_tpu.nlp.sentence_iterator import (  # noqa: F401
     BasicLineIterator,

@@ -142,6 +142,122 @@ class _HierarchicSoftmaxStep:
         return self._fn(syn0, syn1, center, points, codes, mask, lr)
 
 
+class _CbowNegSamplingStep:
+    """jit'd CBOW negative-sampling update (ref CBOW.java + word2vec.c
+    cbow-mean path): input = masked mean of the context vectors, targets
+    = center + negatives; the input gradient is applied to every context
+    word unscaled, matching the reference. Same scan-chunked sequential
+    semantics as the skip-gram steps."""
+
+    def __init__(self, chunk: int = 32):
+        self.chunk = chunk
+        self._fn = None
+
+    def __call__(self, syn0, syn1neg, ctx_words, ctx_mask, targets,
+                 labels, lr):
+        import jax
+        import jax.numpy as jnp
+
+        if self._fn is None:
+            chunk = self.chunk
+
+            def step(syn0, syn1neg, cw, cm, tgt, lab, lr):
+                B, W = cw.shape
+                K1 = tgt.shape[1]
+                c = _chunk_of(B, chunk)
+                S = B // c
+
+                def body(carry, xs):
+                    syn0, syn1neg = carry
+                    cw, cm, tgt, lab = xs
+                    counts = jnp.maximum(jnp.sum(cm, axis=1), 1.0)
+                    h = (jnp.einsum("bwd,bw->bd", syn0[cw], cm)
+                         / counts[:, None])                  # [c,D]
+                    u = syn1neg[tgt]                          # [c,K+1,D]
+                    p = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", h, u))
+                    g = (lab - p) * lr
+                    du = jnp.einsum("bk,bd->bkd", g, h)
+                    dh = jnp.einsum("bk,bkd->bd", g, u)
+                    syn1neg = syn1neg.at[tgt.reshape(-1)].add(
+                        du.reshape(-1, du.shape[-1]))
+                    dctx = dh[:, None, :] * cm[:, :, None]    # [c,W,D]
+                    syn0 = syn0.at[cw.reshape(-1)].add(
+                        dctx.reshape(-1, dctx.shape[-1]))
+                    eps = 1e-7
+                    loss = -jnp.mean(
+                        lab * jnp.log(p + eps)
+                        + (1 - lab) * jnp.log(1 - p + eps))
+                    return (syn0, syn1neg), loss
+
+                (syn0, syn1neg), losses = jax.lax.scan(
+                    body, (syn0, syn1neg),
+                    (cw.reshape(S, c, W), cm.reshape(S, c, W),
+                     tgt.reshape(S, c, K1), lab.reshape(S, c, K1)))
+                return syn0, syn1neg, jnp.mean(losses)
+
+            self._fn = jax.jit(step, donate_argnums=(0, 1))
+        return self._fn(syn0, syn1neg, ctx_words, ctx_mask, targets,
+                        labels, lr)
+
+
+class _CbowHierarchicSoftmaxStep:
+    """jit'd CBOW hierarchical-softmax update (ref CBOW.java HS branch):
+    context-mean input against the CENTER word's Huffman path."""
+
+    def __init__(self, chunk: int = 32):
+        self.chunk = chunk
+        self._fn = None
+
+    def __call__(self, syn0, syn1, ctx_words, ctx_mask, points, codes,
+                 mask, lr):
+        import jax
+        import jax.numpy as jnp
+
+        if self._fn is None:
+            chunk = self.chunk
+
+            def step(syn0, syn1, cw, cm, pts, cds, msk, lr):
+                B, W = cw.shape
+                L = pts.shape[1]
+                c = _chunk_of(B, chunk)
+                S = B // c
+
+                def body(carry, xs):
+                    syn0, syn1 = carry
+                    cw, cm, pts, cds, msk = xs
+                    counts = jnp.maximum(jnp.sum(cm, axis=1), 1.0)
+                    h = (jnp.einsum("bwd,bw->bd", syn0[cw], cm)
+                         / counts[:, None])
+                    u = syn1[pts]                             # [c,L,D]
+                    p = jax.nn.sigmoid(jnp.einsum("bd,bld->bl", h, u))
+                    g = ((1.0 - cds) - p) * msk * lr
+                    du = jnp.einsum("bl,bd->bld", g, h)
+                    dh = jnp.einsum("bl,bld->bd", g, u)
+                    syn1 = syn1.at[pts.reshape(-1)].add(
+                        du.reshape(-1, du.shape[-1]))
+                    dctx = dh[:, None, :] * cm[:, :, None]
+                    syn0 = syn0.at[cw.reshape(-1)].add(
+                        dctx.reshape(-1, dctx.shape[-1]))
+                    eps = 1e-7
+                    tgt = 1.0 - cds
+                    ll = (tgt * jnp.log(p + eps)
+                          + (1 - tgt) * jnp.log(1 - p + eps))
+                    loss = (-jnp.sum(ll * msk)
+                            / jnp.maximum(jnp.sum(msk), 1.0))
+                    return (syn0, syn1), loss
+
+                (syn0, syn1), losses = jax.lax.scan(
+                    body, (syn0, syn1),
+                    (cw.reshape(S, c, W), cm.reshape(S, c, W),
+                     pts.reshape(S, c, L), cds.reshape(S, c, L),
+                     msk.reshape(S, c, L)))
+                return syn0, syn1, jnp.mean(losses)
+
+            self._fn = jax.jit(step, donate_argnums=(0, 1))
+        return self._fn(syn0, syn1, ctx_words, ctx_mask, points, codes,
+                        mask, lr)
+
+
 class SequenceVectors:
     """Generic embedding trainer over token sequences."""
 
@@ -177,6 +293,8 @@ class SequenceVectors:
         self.batch_size = -(-batch_size // self._chunk) * self._chunk
         self._neg_step = _NegSamplingStep(chunk=self._chunk)
         self._hs_step = _HierarchicSoftmaxStep(chunk=self._chunk)
+        self._cbow_neg_step = _CbowNegSamplingStep(chunk=self._chunk)
+        self._cbow_hs_step = _CbowHierarchicSoftmaxStep(chunk=self._chunk)
 
     # ------------------------------------------------------------- vocab
     def build_vocab(self, sequences: Iterable[Sequence[str]]):
@@ -230,6 +348,19 @@ class SequenceVectors:
                     if 0 <= j < n:
                         yield center, idxs[j]
 
+    def _gen_cbow_examples(self, sequences, rng):
+        """Yield (center, [context indices]) with the reduced-window
+        trick — one CBOW example per position (ref CBOW.java)."""
+        for seq in sequences:
+            idxs = self._sequence_indices(seq, rng)
+            n = len(idxs)
+            for pos, center in enumerate(idxs):
+                b = rng.integers(1, self.window + 1)
+                ctx = [idxs[pos + off] for off in range(-b, b + 1)
+                       if off != 0 and 0 <= pos + off < n]
+                if ctx:
+                    yield center, ctx
+
     # ------------------------------------------------------------- fit
     def fit(self, sequences: Iterable[Sequence[str]]):
         seqs = [list(s) for s in sequences]
@@ -243,25 +374,30 @@ class SequenceVectors:
         syn1neg = (None if self.syn1neg is None
                    else jnp.asarray(self.syn1neg))
 
-        # rough total pair count for the linear lr decay
+        # rough total example count for the linear lr decay: skip-gram
+        # emits ~window pairs per position, CBOW one example per position
+        per_pos = 1 if self.use_cbow else self.window
         approx_pairs = max(
-            1, sum(len(s) for s in seqs) * self.window * self.epochs)
+            1, sum(len(s) for s in seqs) * per_pos * self.epochs)
         seen = 0
+        gen = (self._gen_cbow_examples if self.use_cbow
+               else self._gen_pairs)
+        flush = self._flush_cbow if self.use_cbow else self._flush
         for _ in range(self.epochs):
             order = rng.permutation(len(seqs))
             buf_c, buf_x = [], []
             for si in order:
-                for c, x in self._gen_pairs([seqs[si]], rng):
+                for c, x in gen([seqs[si]], rng):
                     buf_c.append(c)
                     buf_x.append(x)
                     if len(buf_c) >= self.batch_size:
-                        syn0, syn1, syn1neg = self._flush(
+                        syn0, syn1, syn1neg = flush(
                             syn0, syn1, syn1neg, buf_c, buf_x, rng,
                             seen, approx_pairs)
                         seen += len(buf_c)
                         buf_c, buf_x = [], []
             if buf_c:
-                syn0, syn1, syn1neg = self._flush(
+                syn0, syn1, syn1neg = flush(
                     syn0, syn1, syn1neg, buf_c, buf_x, rng, seen,
                     approx_pairs)
                 seen += len(buf_c)
@@ -275,54 +411,100 @@ class SequenceVectors:
         return max(self.min_learning_rate,
                    self.learning_rate * (1.0 - frac))
 
+    def _pad_batch_lists(self, *bufs):
+        """Pad the final ragged batch to the fixed batch size so the jit
+        step compiles exactly once (padding replicates the last example;
+        the few duplicated updates there are negligible). batch_size is
+        already a chunk multiple (__init__), so full batches need none."""
+        B = self.batch_size
+        out = []
+        for buf in bufs:
+            if len(buf) < B:
+                buf = buf + [buf[-1]] * (B - len(buf))
+            out.append(buf)
+        return out
+
+    def _pack_hs(self, targets):
+        """Pack the targets' Huffman (points, codes, mask) arrays."""
+        B = self.batch_size
+        L = max(self._max_code_len, 1)
+        words = self.vocab.vocab_words()
+        pts = np.zeros((B, L), np.int32)
+        cds = np.zeros((B, L), np.float32)
+        msk = np.zeros((B, L), np.float32)
+        for i, x in enumerate(targets):
+            w = words[x]
+            l = len(w.codes)
+            pts[i, :l] = w.points
+            cds[i, :l] = w.codes
+            msk[i, :l] = 1.0
+        return pts, cds, msk
+
+    def _sample_negatives(self, positives, rng):
+        """[B, K+1] targets (positive first) + [B, K+1] labels.
+        Negatives colliding with the row's positive are resampled — the
+        reference resamples on collision (SkipGram.java:258); a collision
+        would label the same index 1 and 0 in one update."""
+        B = self.batch_size
+        K = self.negative
+        V = self.vocab.num_words()
+        pos = np.asarray(positives, np.int64)[:, None]
+        neg = rng.choice(V, size=(B, K), p=self._unigram)
+        for _ in range(16):
+            coll = neg == pos
+            n_coll = int(coll.sum())
+            if not n_coll:
+                break
+            neg[coll] = rng.choice(V, size=n_coll, p=self._unigram)
+        targets = np.concatenate([pos, neg], axis=1)
+        labels = np.zeros((B, K + 1), np.float32)
+        labels[:, 0] = 1.0
+        return targets, labels
+
     def _flush(self, syn0, syn1, syn1neg, buf_c, buf_x, rng, seen, total):
         import jax.numpy as jnp
 
-        # pad the final ragged batch to the fixed batch size so the jit
-        # step compiles exactly once (padding replicates the last pair;
-        # the few duplicated updates there are negligible). batch_size is
-        # already a chunk multiple (__init__), so full batches need none.
-        B = self.batch_size
-        if len(buf_c) < B:
-            reps = B - len(buf_c)
-            buf_c = buf_c + [buf_c[-1]] * reps
-            buf_x = buf_x + [buf_x[-1]] * reps
+        buf_c, buf_x = self._pad_batch_lists(buf_c, buf_x)
         center = jnp.asarray(np.asarray(buf_c, np.int32))
         lr = jnp.float32(self._lr(seen, total))
         if self.use_hs:
-            L = max(self._max_code_len, 1)
-            words = self.vocab.vocab_words()
-            pts = np.zeros((B, L), np.int32)
-            cds = np.zeros((B, L), np.float32)
-            msk = np.zeros((B, L), np.float32)
-            for i, x in enumerate(buf_x):
-                w = words[x]
-                l = len(w.codes)
-                pts[i, :l] = w.points
-                cds[i, :l] = w.codes
-                msk[i, :l] = 1.0
+            pts, cds, msk = self._pack_hs(buf_x)
             syn0, syn1, _ = self._hs_step(
                 syn0, syn1, center, jnp.asarray(pts), jnp.asarray(cds),
                 jnp.asarray(msk), lr)
         if self.negative > 0:
-            K = self.negative
-            V = self.vocab.num_words()
-            pos = np.asarray(buf_x, np.int64)[:, None]
-            neg = rng.choice(V, size=(B, K), p=self._unigram)
-            # resample negatives colliding with the row's positive target —
-            # the reference resamples on collision (SkipGram.java:258); a
-            # collision would label the same index 1 and 0 in one update
-            for _ in range(16):
-                coll = neg == pos
-                n_coll = int(coll.sum())
-                if not n_coll:
-                    break
-                neg[coll] = rng.choice(V, size=n_coll, p=self._unigram)
-            ctx = np.concatenate([pos, neg], axis=1)
-            labels = np.zeros((B, K + 1), np.float32)
-            labels[:, 0] = 1.0
+            ctx, labels = self._sample_negatives(buf_x, rng)
             syn0, syn1neg, _ = self._neg_step(
                 syn0, syn1neg, center, jnp.asarray(ctx, jnp.int32),
+                jnp.asarray(labels), lr)
+        return syn0, syn1, syn1neg
+
+    def _flush_cbow(self, syn0, syn1, syn1neg, buf_c, buf_x, rng, seen,
+                    total):
+        """CBOW batch: buf_c = center indices, buf_x = context lists."""
+        import jax.numpy as jnp
+
+        buf_c, buf_x = self._pad_batch_lists(buf_c, buf_x)
+        B = self.batch_size
+        W = 2 * self.window
+        cw = np.zeros((B, W), np.int32)
+        cm = np.zeros((B, W), np.float32)
+        for i, ctx in enumerate(buf_x):
+            n = min(len(ctx), W)
+            cw[i, :n] = ctx[:n]
+            cm[i, :n] = 1.0
+        cw_j = jnp.asarray(cw)
+        cm_j = jnp.asarray(cm)
+        lr = jnp.float32(self._lr(seen, total))
+        if self.use_hs:
+            pts, cds, msk = self._pack_hs(buf_c)
+            syn0, syn1, _ = self._cbow_hs_step(
+                syn0, syn1, cw_j, cm_j, jnp.asarray(pts),
+                jnp.asarray(cds), jnp.asarray(msk), lr)
+        if self.negative > 0:
+            tgt, labels = self._sample_negatives(buf_c, rng)
+            syn0, syn1neg, _ = self._cbow_neg_step(
+                syn0, syn1neg, cw_j, cm_j, jnp.asarray(tgt, jnp.int32),
                 jnp.asarray(labels), lr)
         return syn0, syn1, syn1neg
 

@@ -42,6 +42,38 @@ class EndingPreProcessor(TokenPreProcess):
         return token
 
 
+_DEFAULT_STOP_WORDS = frozenset("""
+a an and are as at be but by for if in into is it no not of on or such
+that the their then there these they this to was will with i you he she
+we me him her his hers its our your yours them what which who whom
+""".split())
+
+
+class StopWords:
+    """Default English stop-word list (ref: text/stopwords/StopWords.java
+    loading stopwords from the bundled resource)."""
+
+    @staticmethod
+    def get_stop_words() -> List[str]:
+        return sorted(_DEFAULT_STOP_WORDS)
+
+
+class StopWordsPreProcessor(TokenPreProcess):
+    """Drops stop words (returns '' so the Tokenizer filters them);
+    composes with a base preprocessor applied first."""
+
+    def __init__(self, stop_words=None,
+                 base: Optional[TokenPreProcess] = None):
+        self.stop = frozenset(w.lower() for w in (
+            stop_words if stop_words is not None else _DEFAULT_STOP_WORDS))
+        self.base = base
+
+    def pre_process(self, token: str) -> str:
+        if self.base is not None:
+            token = self.base.pre_process(token)
+        return "" if token.lower() in self.stop else token
+
+
 class Tokenizer:
     def __init__(self, tokens: List[str],
                  preprocessor: Optional[TokenPreProcess] = None):

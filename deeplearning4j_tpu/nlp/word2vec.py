@@ -40,6 +40,21 @@ class Word2Vec(SequenceVectors):
             self._kw["use_hierarchic_softmax"] = bool(v)
             return self
 
+        def elements_learning_algorithm(self, name):
+            """'SkipGram' (default) or 'CBOW'
+            (ref Word2Vec.Builder.elementsLearningAlgorithm)."""
+            n = str(name).lower()
+            if n not in ("skipgram", "cbow"):
+                raise ValueError(
+                    f"unknown elements learning algorithm '{name}' "
+                    "(SkipGram | CBOW)")
+            self._kw["use_cbow"] = n == "cbow"
+            return self
+
+        def use_cbow(self, v=True):
+            self._kw["use_cbow"] = bool(v)
+            return self
+
         def min_word_frequency(self, v):
             self._kw["min_word_frequency"] = int(v)
             return self

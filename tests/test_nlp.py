@@ -57,13 +57,16 @@ def test_tokenizer_preprocessing():
     assert all("!" not in t and "," not in t for t in toks)
 
 
-@pytest.mark.parametrize("mode", ["negative", "hs"])
+@pytest.mark.parametrize(
+    "mode", ["negative", "hs", "cbow-negative", "cbow-hs"])
 def test_word2vec_semantic_clusters(mode):
     sents, animals, tech = _corpus()
     w2v = (Word2Vec.Builder()
            .layer_size(24).window_size(4)
-           .negative_sample(5 if mode == "negative" else 0)
-           .use_hierarchic_softmax(mode == "hs")
+           .negative_sample(5 if mode.endswith("negative") else 0)
+           .use_hierarchic_softmax(mode.endswith("hs"))
+           .elements_learning_algorithm(
+               "CBOW" if mode.startswith("cbow") else "SkipGram")
            .min_word_frequency(1).epochs(3).batch_size(256).seed(1)
            .iterate(CollectionSentenceIterator(sents))
            .build())
@@ -154,3 +157,19 @@ def test_bow_tfidf():
     # 'the' (2 docs) weighted below 'cpu' (1 doc) within doc 2
     i_cpu = tfidf.vocab.index_of("cpu")
     assert t[2, i_cpu] > t[0, tfidf.vocab.index_of("the")]
+
+
+def test_stopwords_preprocessor():
+    from deeplearning4j_tpu.nlp import (
+        CommonPreprocessor,
+        DefaultTokenizerFactory,
+        StopWords,
+        StopWordsPreProcessor,
+    )
+
+    assert "the" in StopWords.get_stop_words()
+    tf = DefaultTokenizerFactory()
+    tf.set_token_pre_processor(
+        StopWordsPreProcessor(base=CommonPreprocessor()))
+    toks = tf.create("The cat and the dog!").get_tokens()
+    assert toks == ["cat", "dog"]

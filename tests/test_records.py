@@ -1,0 +1,146 @@
+"""Record-reader bridge tests (ref: RecordReaderDataSetIterator /
+SequenceRecordReaderDataSetIterator / RecordReaderMultiDataSetIterator
+test suites in deeplearning4j-core datasets/datavec)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.datasets import (
+    CollectionRecordReader,
+    CollectionSequenceRecordReader,
+    CSVRecordReader,
+    CSVSequenceRecordReader,
+    RecordReaderDataSetIterator,
+    RecordReaderMultiDataSetIterator,
+    SequenceRecordReaderDataSetIterator,
+)
+from deeplearning4j_tpu.nn.conf import InputType
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+
+def test_csv_record_reader(tmp_path):
+    p = tmp_path / "d.csv"
+    p.write_text("h1,h2,h3\n1,2,0\n3,4,1\n5,6,2\n")
+    rr = CSVRecordReader(str(p), skip_lines=1)
+    rows = list(rr)
+    assert rows == [["1", "2", "0"], ["3", "4", "1"], ["5", "6", "2"]]
+    # re-iterable
+    assert len(list(rr)) == 3
+
+
+def test_record_reader_dataset_iterator_classification(tmp_path):
+    p = tmp_path / "d.csv"
+    lines = [f"{i},{i * 2},{i % 3}" for i in range(10)]
+    p.write_text("\n".join(lines))
+    it = RecordReaderDataSetIterator(
+        CSVRecordReader(str(p)), batch_size=4, label_index=2, num_classes=3)
+    batches = list(it)
+    assert [b.num_examples() for b in batches] == [4, 4, 2]
+    b0 = batches[0]
+    assert b0.features.shape == (4, 2)
+    assert b0.labels.shape == (4, 3)
+    np.testing.assert_array_equal(b0.features[1], [1.0, 2.0])
+    assert b0.labels[2][2] == 1.0   # row 2: label 2 % 3
+    # reset + re-iterate
+    assert len(list(it)) == 3
+
+
+def test_record_reader_regression():
+    rows = [[1, 2, 0.5, 1.5], [3, 4, 2.5, 3.5]]
+    it = RecordReaderDataSetIterator(
+        CollectionRecordReader(rows), batch_size=2,
+        label_index=2, label_index_to=3, regression=True)
+    b = next(iter(it))
+    assert b.features.shape == (2, 2)
+    np.testing.assert_allclose(b.labels, [[0.5, 1.5], [2.5, 3.5]])
+
+
+def test_classification_requires_num_classes():
+    with pytest.raises(ValueError, match="num_classes"):
+        RecordReaderDataSetIterator(
+            CollectionRecordReader([[1, 0]]), 2, label_index=1)
+
+
+def test_sequence_record_reader(tmp_path):
+    # two sequences with different lengths -> padded + masked
+    p1 = tmp_path / "s1.csv"
+    p1.write_text("1,2,0\n3,4,1\n5,6,0\n")
+    p2 = tmp_path / "s2.csv"
+    p2.write_text("7,8,1\n9,10,0\n")
+    it = SequenceRecordReaderDataSetIterator(
+        CSVSequenceRecordReader([str(p1), str(p2)]), batch_size=2,
+        label_index=2, num_classes=2)
+    b = next(iter(it))
+    assert b.features.shape == (2, 3, 2)
+    assert b.labels.shape == (2, 3, 2)
+    np.testing.assert_array_equal(b.features_mask, [[1, 1, 1], [1, 1, 0]])
+    np.testing.assert_array_equal(b.labels_mask, [[1, 1, 1], [1, 1, 0]])
+    np.testing.assert_array_equal(b.features[1, 0], [7.0, 8.0])
+    assert b.labels[1, 0, 1] == 1.0
+    assert b.features[1, 2].sum() == 0.0   # padding
+
+
+def test_multi_dataset_iterator():
+    rows = [[i, i + 1, i % 2, i * 0.1] for i in range(6)]
+    it = (RecordReaderMultiDataSetIterator.Builder(batch_size=3)
+          .add_reader("r", CollectionRecordReader(rows))
+          .add_input("r", 0, 1)
+          .add_output_one_hot("r", 2, 2)
+          .add_output("r", 3, 3)
+          .build())
+    batches = list(it)
+    assert len(batches) == 2
+    md = batches[0]
+    assert md.features[0].shape == (3, 2)
+    assert md.labels[0].shape == (3, 2)   # one-hot
+    assert md.labels[1].shape == (3, 1)   # regression col
+    np.testing.assert_allclose(md.labels[1][:, 0], [0.0, 0.1, 0.2],
+                               atol=1e-6)
+
+
+def test_builder_validates_reader_names():
+    with pytest.raises(ValueError, match="no reader"):
+        (RecordReaderMultiDataSetIterator.Builder(2)
+         .add_input("missing").add_output("missing", 0, 0).build())
+
+
+def test_csv_classification_end_to_end(tmp_path):
+    """CSV -> iterator -> fit -> accuracy (VERDICT item 8 done-check)."""
+    rng = np.random.default_rng(0)
+    lines = []
+    for _ in range(120):
+        c = rng.integers(0, 2)
+        x1 = rng.normal() + 3 * c
+        x2 = rng.normal() - 3 * c
+        lines.append(f"{x1:.4f},{x2:.4f},{c}")
+    p = tmp_path / "train.csv"
+    p.write_text("\n".join(lines))
+
+    it = RecordReaderDataSetIterator(
+        CSVRecordReader(str(p)), batch_size=32, label_index=2,
+        num_classes=2)
+    conf = (NeuralNetConfiguration.Builder().seed(1).updater("adam")
+            .learning_rate(5e-2).weight_init("xavier").list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=2, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(2)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(it, epochs=30)
+
+    from deeplearning4j_tpu.eval import Evaluation
+
+    ev = Evaluation(2)
+    for b in it:
+        ev.eval(b.labels, np.asarray(net.output(b.features)))
+    assert ev.accuracy() > 0.95
+
+
+def test_in_memory_sequence_reader():
+    seqs = [[[1, 0], [2, 1]], [[3, 0]]]
+    it = SequenceRecordReaderDataSetIterator(
+        CollectionSequenceRecordReader(seqs), batch_size=2,
+        label_index=1, num_classes=2)
+    b = next(iter(it))
+    assert b.features.shape == (2, 2, 1)
+    assert b.labels_mask[1, 1] == 0.0
