@@ -85,6 +85,7 @@ class ComputationGraphConfiguration:
     lr_policy_power: float = 1.0
     lr_schedule: Optional[Dict[int, float]] = None
     minibatch: bool = True
+    optimization_algo: str = "stochastic_gradient_descent"
     backprop_type: str = BackpropType.STANDARD
     tbptt_fwd_length: int = 20
     tbptt_back_length: int = 20
@@ -351,7 +352,6 @@ class GraphBuilder:
 
             g = self._global._g
             extra = dict(self._global._extra)
-            extra.pop("optimization_algo", None)
             conf.seed = g["seed"]
             conf.updater = g["updater"]
             conf.learning_rate = g["learning_rate"]

@@ -74,6 +74,11 @@ class MultiLayerConfiguration:
     # minibatch loss scaling: divide loss by batch size (reference default true)
     minibatch: bool = True
 
+    # ref OptimizationAlgorithm enum: stochastic_gradient_descent (the
+    # fused updater step) | lbfgs | conjugate_gradient |
+    # line_gradient_descent (optimize/solvers.py)
+    optimization_algo: str = "stochastic_gradient_descent"
+
     backprop_type: str = BackpropType.STANDARD
     tbptt_fwd_length: int = 20
     tbptt_back_length: int = 20
@@ -299,7 +304,6 @@ class NeuralNetConfiguration:
         def build(self) -> MultiLayerConfiguration:
             g = self._builder._g
             extra = dict(self._builder._extra)
-            extra.pop("optimization_algo", None)
             layers = [copy.deepcopy(l) for l in self._layers]
             if any(l is None for l in layers):
                 raise ValueError("Layer list has gaps")

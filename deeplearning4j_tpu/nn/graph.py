@@ -367,6 +367,16 @@ class ComputationGraph:
         if (conf.backprop_type == BackpropType.TRUNCATED_BPTT
                 and all(x.ndim == 3 for x in inputs.values())):
             self._fit_tbptt(inputs, labels, fmasks, lmasks)
+        elif getattr(conf, "optimization_algo",
+                     "stochastic_gradient_descent") not in (
+                "stochastic_gradient_descent", "sgd"):
+            from deeplearning4j_tpu.optimize.solvers import make_solver
+
+            if getattr(self, "_solver", None) is None:
+                self._solver = make_solver(conf.optimization_algo, self)
+            loss = self._solver.step(inputs, labels, fmasks, lmasks)
+            self.iteration += 1
+            self._score = loss
         else:
             self._train_step(inputs, labels, fmasks, lmasks)
 

@@ -366,6 +366,8 @@ class MultiLayerNetwork:
                 if (self.conf.backprop_type == BackpropType.TRUNCATED_BPTT
                         and x.ndim == 3):
                     loss = self._fit_tbptt(x, y, fm, lm)
+                elif self._use_solver():
+                    loss = self._solver_step(x, y, fm, lm)
                 else:
                     loss, _ = self._train_step(x, y, fm, lm)
                 for listener in self.listeners:
@@ -375,6 +377,23 @@ class MultiLayerNetwork:
                 if hasattr(listener, "on_epoch_end"):
                     listener.on_epoch_end(self)
         return self
+
+    def _use_solver(self) -> bool:
+        return getattr(self.conf, "optimization_algo",
+                       "stochastic_gradient_descent") not in (
+            "stochastic_gradient_descent", "sgd")
+
+    def _solver_step(self, x, y, fm, lm):
+        """One line-search solver iteration on this batch
+        (ref Solver.optimize -> BaseOptimizer.optimize :198)."""
+        from deeplearning4j_tpu.optimize.solvers import make_solver
+
+        if getattr(self, "_solver", None) is None:
+            self._solver = make_solver(self.conf.optimization_algo, self)
+        loss = self._solver.step(x, y, fm, lm)
+        self.iteration += 1
+        self._score = loss
+        return loss
 
     def _fit_tbptt(self, x, y, fm, lm):
         """Truncated BPTT (ref: MLN.truncatedBPTTGradient():1395): slice the

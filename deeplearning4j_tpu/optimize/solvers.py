@@ -1,0 +1,244 @@
+"""Line-search solvers: LBFGS, ConjugateGradient, LineGradientDescent +
+BackTrackLineSearch.
+
+Parity: optimize/solvers/ — BaseOptimizer.java:55 (gradientAndScore
+:172, optimize :198), LBFGS.java (m=10 two-loop recursion),
+ConjugateGradient.java (Polak-Ribiere with restart),
+LineGradientDescent.java, BackTrackLineSearch.java (Armijo sufficient-
+decrease backtracking). Selected via
+`optimization_algo("lbfgs"|"conjugate_gradient"|"line_gradient_descent")`
+on the configuration builder; "stochastic_gradient_descent" (default)
+keeps the fused updater step.
+
+TPU-native design: the loss+gradient over the FLATTENED parameter
+vector is one jitted program reused across line-search probes (probes
+re-enter the same compiled fn with a new flat vector); the two-loop
+recursion and direction updates are tiny O(N) vector ops. The solver
+runs per minibatch like the reference's Solver.optimize loop, carrying
+curvature history across batches.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class BackTrackLineSearch:
+    """Armijo backtracking (ref BackTrackLineSearch.java: stpmax,
+    maxIterations, sufficient-decrease c1=1e-4, halving steps)."""
+
+    def __init__(self, c1: float = 1e-4, rho: float = 0.5,
+                 max_iterations: int = 10, step_max: float = 10.0):
+        self.c1 = c1
+        self.rho = rho
+        self.max_iterations = max_iterations
+        self.step_max = step_max
+
+    def search(self, f, x0, f0, g0, direction, alpha0: float = 1.0):
+        """Minimize f along `direction` from x0. Returns (alpha, f_new)
+        with alpha=0.0 if no decrease was found."""
+        gd = float(jnp.vdot(g0, direction))
+        if gd >= 0:
+            # not a descent direction: caller should reset (ref
+            # BaseOptimizer's GradientStepFunction fallback)
+            return 0.0, f0
+        alpha = min(float(alpha0), self.step_max)
+        for _ in range(self.max_iterations):
+            f_new = float(f(x0 + alpha * direction))
+            if np.isfinite(f_new) and f_new <= f0 + self.c1 * alpha * gd:
+                return alpha, f_new
+            alpha *= self.rho
+        return 0.0, f0
+
+
+class _FlatProblem:
+    """Flattened view of a net's loss for the solvers: one jitted
+    value_and_grad over a flat f32 vector (probes reuse the compiled
+    program; BN state updates from the accepted point are kept)."""
+
+    def __init__(self, net):
+        from jax.flatten_util import ravel_pytree
+
+        self.net = net
+        flat, self.unravel = ravel_pytree(net.params)
+        self.n = flat.size
+        self.is_graph = hasattr(net.conf, "network_inputs")
+
+        def loss_flat(flat, states, x, y, fm, lm):
+            params = self.unravel(flat)
+            if self.is_graph:
+                loss, (new_states, _) = net._loss_fn(
+                    params, states, x, y, None, fm, lm, rnn_carries=None)
+            else:
+                loss, (new_states, _) = net._loss_fn(
+                    params, states, x, y, None, fm, lm, rnn_carries=None)
+            return loss, new_states
+
+        self._vg = jax.jit(jax.value_and_grad(loss_flat, has_aux=True))
+        self._val = jax.jit(lambda *a: loss_flat(*a)[0])
+
+    def flat_params(self):
+        from jax.flatten_util import ravel_pytree
+
+        return ravel_pytree(self.net.params)[0]
+
+    def value_and_grad(self, flat, x, y, fm, lm):
+        (loss, new_states), grad = self._vg(
+            flat, self.net.states, x, y, fm, lm)
+        return float(loss), grad, new_states
+
+    def value(self, flat, x, y, fm, lm):
+        return self._val(flat, self.net.states, x, y, fm, lm)
+
+    def commit(self, flat, new_states=None):
+        self.net.params = self.unravel(flat)
+        if new_states is not None:
+            self.net.states = new_states
+
+
+class BaseLineSearchOptimizer:
+    """Per-minibatch optimize step (ref BaseOptimizer.optimize :198)."""
+
+    name = "base"
+
+    def __init__(self, net, line_search: Optional[BackTrackLineSearch]
+                 = None):
+        self.net = net
+        self.problem = _FlatProblem(net)
+        self.line_search = line_search or BackTrackLineSearch()
+        self._state: Any = None
+
+    def _direction(self, grad) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def _accepted(self, alpha, step, grad):
+        pass
+
+    def _alpha0(self) -> float:
+        return 1.0
+
+    def step(self, x, y, fm=None, lm=None) -> float:
+        pb = self.problem
+        flat = pb.flat_params()
+        f0, grad, _ = pb.value_and_grad(flat, x, y, fm, lm)
+        d = self._direction(grad)
+        alpha, f_new = self.line_search.search(
+            lambda v: pb.value(v, x, y, fm, lm), flat, f0, grad, d,
+            self._alpha0())
+        if alpha == 0.0:
+            # no decrease along d: restart from steepest descent
+            self._state = None
+            d = -grad
+            alpha, f_new = self.line_search.search(
+                lambda v: pb.value(v, x, y, fm, lm), flat, f0, grad, d,
+                self.net.conf.learning_rate)
+            if alpha == 0.0:
+                return f0
+        new_flat = flat + alpha * d
+        # re-evaluate at the accepted point to pick up BN state updates
+        _, _, new_states = pb.value_and_grad(new_flat, x, y, fm, lm)
+        pb.commit(new_flat, new_states)
+        self._accepted(alpha, alpha * d, grad)
+        return f_new
+
+
+class LineGradientDescent(BaseLineSearchOptimizer):
+    """Steepest descent + line search (ref LineGradientDescent.java)."""
+
+    name = "line_gradient_descent"
+
+    def _direction(self, grad):
+        return -grad
+
+    def _alpha0(self):
+        return self.net.conf.learning_rate
+
+
+class ConjugateGradient(BaseLineSearchOptimizer):
+    """Nonlinear CG, Polak-Ribiere+ with automatic restart
+    (ref ConjugateGradient.java)."""
+
+    name = "conjugate_gradient"
+
+    def _direction(self, grad):
+        if self._state is None:
+            d = -grad
+        else:
+            g_prev, d_prev = self._state
+            beta = float(jnp.vdot(grad, grad - g_prev)
+                         / jnp.maximum(jnp.vdot(g_prev, g_prev), 1e-20))
+            beta = max(beta, 0.0)   # PR+ restart
+            d = -grad + beta * d_prev
+        self._g_last = grad
+        self._d_last = d
+        return d
+
+    def _accepted(self, alpha, step, grad):
+        self._state = (self._g_last, self._d_last)
+
+
+class LBFGS(BaseLineSearchOptimizer):
+    """Limited-memory BFGS, m=10 two-loop recursion (ref LBFGS.java)."""
+
+    name = "lbfgs"
+
+    def __init__(self, net, m: int = 10, **kw):
+        super().__init__(net, **kw)
+        self.m = m
+        self._state = None   # (prev_flat, prev_grad, [(s, y, rho), ...])
+
+    def _direction(self, grad):
+        if self._state is None:
+            self._hist = []
+        else:
+            prev_flat, prev_grad, hist = self._state
+            s = self._flat_now - prev_flat
+            yv = grad - prev_grad
+            sy = float(jnp.vdot(s, yv))
+            if sy > 1e-10:   # curvature condition
+                hist = (hist + [(s, yv, 1.0 / sy)])[-self.m:]
+            self._hist = hist
+        q = grad
+        alphas = []
+        for s, yv, rho in reversed(self._hist):
+            a = rho * jnp.vdot(s, q)
+            alphas.append((a, rho, s, yv))
+            q = q - a * yv
+        if self._hist:
+            s, yv, _ = self._hist[-1]
+            gamma = jnp.vdot(s, yv) / jnp.maximum(jnp.vdot(yv, yv), 1e-20)
+            q = q * gamma
+        for a, rho, s, yv in reversed(alphas):
+            b = rho * jnp.vdot(yv, q)
+            q = q + s * (a - b)
+        self._g_last = grad
+        return -q
+
+    def step(self, x, y, fm=None, lm=None) -> float:
+        self._flat_now = self.problem.flat_params()
+        return super().step(x, y, fm, lm)
+
+    def _accepted(self, alpha, step, grad):
+        self._state = (self._flat_now, self._g_last, self._hist)
+
+
+_SOLVERS = {
+    "lbfgs": LBFGS,
+    "conjugate_gradient": ConjugateGradient,
+    "line_gradient_descent": LineGradientDescent,
+}
+
+
+def make_solver(algo: str, net):
+    key = str(algo).lower()
+    if key in ("stochastic_gradient_descent", "sgd"):
+        return None
+    if key not in _SOLVERS:
+        raise ValueError(
+            f"Unknown optimization algorithm '{algo}'. Known: "
+            f"stochastic_gradient_descent, {', '.join(sorted(_SOLVERS))}")
+    return _SOLVERS[key](net)

@@ -1,0 +1,110 @@
+"""Line-search solver tests (ref: optimize/solvers — LBFGS.java,
+ConjugateGradient.java, BackTrackLineSearch.java; reference tests
+compare convergence against SGD on small convex-ish problems)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf import InputType
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optimize.solvers import (
+    BackTrackLineSearch,
+    make_solver,
+)
+
+
+def _net(algo, seed=3, lr=0.1):
+    conf = (
+        NeuralNetConfiguration.Builder().seed(seed).updater("sgd")
+        .learning_rate(lr).activation("tanh").weight_init("xavier")
+        .optimization_algo(algo)
+        .list()
+        .layer(DenseLayer(n_out=8))
+        .layer(OutputLayer(n_out=3, loss="mcxent"))
+        .set_input_type(InputType.feed_forward(5))
+        .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(rng, n=64):
+    x = rng.normal(size=(n, 5)).astype(np.float32)
+    labels = (x[:, 0] + x[:, 1] > 0).astype(int) + (x[:, 2] > 0.5)
+    y = np.eye(3, dtype=np.float32)[labels]
+    return x, y
+
+
+def test_backtrack_line_search_quadratic():
+    import jax.numpy as jnp
+
+    f = lambda v: jnp.sum((v - 2.0) ** 2)
+    x0 = jnp.zeros((3,))
+    g0 = 2 * (x0 - 2.0)
+    alpha, f_new = BackTrackLineSearch().search(
+        f, x0, float(f(x0)), g0, -g0, alpha0=1.0)
+    assert alpha > 0
+    assert f_new < float(f(x0))
+    # uphill direction -> no step
+    alpha, _ = BackTrackLineSearch().search(
+        f, x0, float(f(x0)), g0, g0, alpha0=1.0)
+    assert alpha == 0.0
+
+
+@pytest.mark.parametrize(
+    "algo", ["lbfgs", "conjugate_gradient", "line_gradient_descent"])
+def test_solver_decreases_loss(algo, rng):
+    x, y = _data(rng)
+    net = _net(algo)
+    net.fit([(x, y)])
+    l0 = float(net.score())
+    net.fit([(x, y)] * 15)
+    assert float(net.score()) < l0 * 0.7
+    assert net.iteration == 16
+
+
+def test_lbfgs_converges_faster_than_sgd(rng):
+    """VERDICT done-check: lbfgs beats SGD on the fixture after equal
+    iterations (full-batch convex-ish problem)."""
+    x, y = _data(rng, n=128)
+    iters = 25
+    sgd = _net("stochastic_gradient_descent")
+    sgd.fit([(x, y)] * iters)
+    lb = _net("lbfgs")
+    lb.fit([(x, y)] * iters)
+    assert float(lb.score()) < float(sgd.score())
+
+
+def test_solver_on_computation_graph(rng):
+    from deeplearning4j_tpu.nn.conf.graph_conf import GraphBuilder
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    x, y = _data(rng)
+    gb = (GraphBuilder(NeuralNetConfiguration.Builder().seed(1)
+                       .updater("sgd").learning_rate(0.1)
+                       .optimization_algo("lbfgs"))
+          .add_inputs("in")
+          .add_layer("h", DenseLayer(n_out=8, activation="tanh"), "in")
+          .add_layer("out", OutputLayer(n_out=3, loss="mcxent"), "h")
+          .set_outputs("out")
+          .set_input_types(**{"in": InputType.feed_forward(5)}))
+    net = ComputationGraph(gb.build()).init()
+    net.fit([([x], [y])])
+    l0 = float(net.score())
+    net.fit([([x], [y])] * 10)
+    assert float(net.score()) < l0
+
+
+def test_unknown_algo_raises(rng):
+    x, y = _data(rng)
+    net = _net("newton")
+    with pytest.raises(ValueError, match="Unknown optimization"):
+        net.fit([(x, y)])
+
+
+def test_optimization_algo_serde_roundtrip():
+    net = _net("lbfgs")
+    from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+
+    js = net.conf.to_json()
+    rt = MultiLayerConfiguration.from_json(js)
+    assert rt.optimization_algo == "lbfgs"
