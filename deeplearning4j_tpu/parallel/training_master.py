@@ -1,0 +1,228 @@
+"""TrainingMaster: multi-host (DCN) data-parallel training orchestration.
+
+Parity: the Spark training stack's role —
+spark/api/TrainingMaster.java (SPI: executeTraining, worker config,
+result aggregation), ParameterAveragingTrainingMaster.java:326 (BSP
+splits + aggregate), ExecuteWorkerFlatMap.java (per-worker data
+partition), SharedTrainingMaster.java:72 (the async gradient mesh).
+
+TPU-native design: instead of Spark shipping serialized models to
+executors and tree-aggregating parameters, every host runs THIS same
+program under `jax.distributed`; the per-host input partition (the
+RDD-partition role) is assembled into one global device array
+(`jax.make_array_from_process_local_data`), and the gradient exchange
+is the XLA all-reduce GSPMD inserts into the SAME compiled train step
+used on one chip — collectives ride ICI within a slice and DCN across
+slices, replacing both the Aeron parameter server and Spark
+treeAggregate (SURVEY §2.4, §5.8).
+
+Fault tolerance (SURVEY §5.3): step-granular checkpoints
+{params, updater state, BN states, iteration, rng} written by process 0
+(shared filesystem assumption, like Spark's checkpoint dir); a killed
+job relaunches with the same arguments and resumes from the latest
+checkpoint — the reference's "stateless per split, re-fit from last
+broadcast" recovery, made explicit.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+
+class TrainingMaster:
+    """Orchestrates SPMD data-parallel training of one net across all
+    processes in a `jax.distributed` job (or a single process).
+
+    Every process must construct the SAME net (same config + seed) and
+    call the same TrainingMaster methods in the same order — standard
+    SPMD discipline (the reference instead broadcasts the model; with
+    identical seeds the construction IS the broadcast)."""
+
+    def __init__(self, net, checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: int = 0, mesh=None):
+        import jax
+        from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+        self.net = net
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = checkpoint_every
+        if mesh is None:
+            mesh = make_mesh(dp=len(jax.devices()))
+        self.mesh = mesh
+        self._staged = False
+
+    # ------------------------------------------------------------ dist init
+    @staticmethod
+    def initialize_distributed(coordinator_address: str,
+                               num_processes: int, process_id: int):
+        """`jax.distributed.initialize` wrapper (must run before any
+        device use). No-op for num_processes == 1."""
+        if num_processes <= 1:
+            return
+        import jax
+
+        try:
+            jax.config.update(
+                "jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass   # non-CPU platforms configure their own collectives
+        jax.distributed.initialize(coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+
+    @staticmethod
+    def process_info() -> Tuple[int, int]:
+        import jax
+
+        return jax.process_index(), jax.process_count()
+
+    # ------------------------------------------------------------- staging
+    def _replicated(self, tree):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sh = NamedSharding(self.mesh, P())
+        return jax.tree_util.tree_map(
+            lambda a: jax.make_array_from_process_local_data(
+                sh, np.asarray(a)), tree)
+
+    def _stage_net(self):
+        if self._staged:
+            return
+        if self.net.params is None:
+            self.net.init()
+        self.net.params = self._replicated(self.net.params)
+        self.net.updater_states = self._replicated(self.net.updater_states)
+        self.net.states = self._replicated(self.net.states)
+        self._staged = True
+
+    def _global_batch(self, x_local, y_local):
+        """Per-host partition -> global [G, ...] device arrays sharded
+        over dp (the ExecuteWorkerFlatMap data-partition role)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sh = NamedSharding(self.mesh, P("dp"))
+        to_g = lambda a: jax.make_array_from_process_local_data(
+            sh, np.asarray(a, np.float32))
+        return to_g(x_local), to_g(y_local)
+
+    # ----------------------------------------------------------------- fit
+    def fit(self, batch_fn: Callable[[int], Tuple], num_steps: int,
+            start_step: Optional[int] = None):
+        """Train for `num_steps` global steps.
+
+        `batch_fn(step) -> (x_local, y_local)`: THIS process's partition
+        of the global batch at `step` (deterministic in step, so resume
+        replays the data stream from the checkpointed position — the
+        step index is the iterator position).
+
+        If `start_step` is None and a checkpoint exists, training
+        resumes after the last checkpointed step."""
+        self._stage_net()
+        net = self.net
+        if start_step is None:
+            start_step = self.load_latest_checkpoint()
+        is_graph = hasattr(net.conf, "network_inputs")
+        with self.mesh:
+            for step in range(start_step, num_steps):
+                x, y = self._global_batch(*batch_fn(step))
+                if is_graph:
+                    name = net.conf.network_inputs[0]
+                    net._train_step({name: x}, [y])
+                else:
+                    net._train_step(x, y)
+                for listener in net.listeners:
+                    listener.iteration_done(net, net.iteration)
+                done = step + 1
+                if (self.checkpoint_dir and self.checkpoint_every
+                        and done % self.checkpoint_every == 0):
+                    self.save_checkpoint(done)
+        return self
+
+    # ------------------------------------------------------- checkpointing
+    def _ckpt_path(self, step: int) -> str:
+        return os.path.join(self.checkpoint_dir, f"step-{step:08d}.npz")
+
+    @staticmethod
+    def _host_leaf(a):
+        """Fetch a (replicated) global array to host."""
+        if hasattr(a, "addressable_shards"):
+            return np.asarray(a.addressable_shards[0].data)
+        return np.asarray(a)
+
+    def save_checkpoint(self, step: int):
+        """Write {params, updater state, states, step, rng} — process 0
+        only (shared-FS model, ref ParameterAveragingTrainingMaster's
+        driver-side ownership)."""
+        import jax
+
+        if jax.process_index() != 0:
+            return
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        net = self.net
+        payload = {}
+        for group, tree in (("params", net.params),
+                            ("upd", net.updater_states),
+                            ("states", net.states)):
+            for i, leaf in enumerate(jax.tree_util.tree_leaves(tree)):
+                payload[f"{group}:{i}"] = self._host_leaf(leaf)
+        payload["rng"] = np.asarray(net._rng)
+        tmp = self._ckpt_path(step) + ".tmp.npz"   # savez appends .npz
+        np.savez(tmp, **payload)
+        os.replace(tmp, self._ckpt_path(step))   # atomic publish
+        meta = {"step": step, "iteration": int(net.iteration),
+                "epoch": int(net.epoch)}
+        with open(os.path.join(self.checkpoint_dir, "latest.json.tmp"),
+                  "w") as f:
+            json.dump(meta, f)
+        os.replace(os.path.join(self.checkpoint_dir, "latest.json.tmp"),
+                   os.path.join(self.checkpoint_dir, "latest.json"))
+
+    def load_latest_checkpoint(self) -> int:
+        """Restore the newest checkpoint if present; returns the step to
+        resume FROM (0 if none). All processes load the same file."""
+        if not self.checkpoint_dir:
+            return 0
+        latest = os.path.join(self.checkpoint_dir, "latest.json")
+        if not os.path.exists(latest):
+            return 0
+        with open(latest) as f:
+            meta = json.load(f)
+        step = meta["step"]
+        data = np.load(self._ckpt_path(step))
+        import jax
+
+        net = self.net
+        if net.params is None:
+            net.init()
+
+        def restore(group, tree):
+            leaves, treedef = jax.tree_util.tree_flatten(tree)
+            new = [data[f"{group}:{i}"] for i in range(len(leaves))]
+            return jax.tree_util.tree_unflatten(treedef, new)
+
+        net.params = self._replicated(restore("params", net.params))
+        net.updater_states = self._replicated(
+            restore("upd", net.updater_states))
+        net.states = self._replicated(restore("states", net.states))
+        net._rng = jax.numpy.asarray(data["rng"])
+        net.iteration = meta["iteration"]
+        net.epoch = meta["epoch"]
+        self._staged = True
+        return step
+
+    def list_checkpoints(self):
+        if not self.checkpoint_dir or not os.path.isdir(self.checkpoint_dir):
+            return []
+        out = []
+        for fn in sorted(os.listdir(self.checkpoint_dir)):
+            m = re.match(r"step-(\d+)\.npz$", fn)
+            if m:
+                out.append(int(m.group(1)))
+        return out

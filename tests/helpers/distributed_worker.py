@@ -1,0 +1,101 @@
+"""Distributed-training worker used by tests/test_distributed.py.
+
+Launched as N subprocesses (one per "host") with
+JAX_PLATFORMS=cpu + --xla_force_host_platform_device_count set by the
+parent; trains a fixed dense net via TrainingMaster, optionally stops
+early ("kill between steps") and resumes from checkpoints.
+
+Usage: distributed_worker.py PID NPROCS PORT STEPS OUT_DIR
+           [--stop-after N] [--checkpoint-every N]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+GLOBAL_BATCH = 32
+FEATURES = 5
+CLASSES = 3
+
+
+def build_net():
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    conf = (NeuralNetConfiguration.Builder().seed(7).updater("adam")
+            .learning_rate(1e-2).activation("tanh").weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=16))
+            .layer(OutputLayer(n_out=CLASSES, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(FEATURES))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def global_batch(step):
+    """Deterministic global batch for `step` (shared by the oracle in
+    the test)."""
+    import numpy as np
+
+    rng = np.random.default_rng(1000 + step)
+    x = rng.normal(size=(GLOBAL_BATCH, FEATURES)).astype(np.float32)
+    labels = rng.integers(0, CLASSES, GLOBAL_BATCH)
+    y = np.eye(CLASSES, dtype=np.float32)[labels]
+    return x, y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("pid", type=int)
+    ap.add_argument("nprocs", type=int)
+    ap.add_argument("port")
+    ap.add_argument("steps", type=int)
+    ap.add_argument("out_dir")
+    ap.add_argument("--stop-after", type=int, default=0)
+    ap.add_argument("--checkpoint-every", type=int, default=0)
+    args = ap.parse_args()
+
+    from deeplearning4j_tpu.parallel.training_master import TrainingMaster
+
+    TrainingMaster.initialize_distributed(
+        f"127.0.0.1:{args.port}", args.nprocs, args.pid)
+
+    import jax
+    import numpy as np
+
+    net = build_net()
+    ckpt = (os.path.join(args.out_dir, "ckpt")
+            if args.checkpoint_every else None)
+    tm = TrainingMaster(net, checkpoint_dir=ckpt,
+                        checkpoint_every=args.checkpoint_every)
+
+    def batch_fn(step):
+        x, y = global_batch(step)
+        per = GLOBAL_BATCH // args.nprocs
+        s = args.pid * per
+        return x[s:s + per], y[s:s + per]
+
+    steps = args.stop_after or args.steps
+    tm.fit(batch_fn, steps)
+
+    if args.stop_after:
+        # simulated kill: exit without finishing; checkpoints remain
+        print(f"pid={args.pid} stopped-after {args.stop_after}",
+              flush=True)
+        return
+
+    if jax.process_index() == 0:
+        leaves = [TrainingMaster._host_leaf(l)
+                  for l in jax.tree_util.tree_leaves(net.params)]
+        np.savez(os.path.join(args.out_dir, "final_params.npz"),
+                 *leaves, score=float(net.score()),
+                 iteration=net.iteration)
+    print(f"pid={args.pid} done score={float(net.score()):.5f}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
