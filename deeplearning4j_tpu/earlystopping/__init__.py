@@ -20,3 +20,6 @@ from deeplearning4j_tpu.earlystopping.trainer import (  # noqa: F401
 from deeplearning4j_tpu.earlystopping.score_calc import (  # noqa: F401
     DataSetLossCalculator,
 )
+from deeplearning4j_tpu.earlystopping.parallel_trainer import (  # noqa: F401
+    EarlyStoppingParallelTrainer,
+)

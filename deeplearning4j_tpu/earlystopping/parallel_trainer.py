@@ -1,0 +1,39 @@
+"""EarlyStoppingParallelTrainer: early stopping driven over a
+data-parallel mesh (parity: deeplearning4j-scaleout-parallelwrapper
+EarlyStoppingParallelTrainer.java — same termination/saver semantics,
+training delegated to ParallelWrapper)."""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.earlystopping.trainer import EarlyStoppingTrainer
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+
+class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
+    def __init__(self, config, net, train_iterator, workers=None,
+                 tp: int = 1, mesh=None, averaging_frequency: int = 1):
+        super().__init__(config, net, train_iterator)
+        self.wrapper = ParallelWrapper(
+            net, workers=workers, tp=tp, mesh=mesh,
+            averaging_frequency=averaging_frequency)
+        self._group = []
+
+    def _fit_batch(self, batch):
+        # buffer to the wrapper's averaging frequency so local-SGD
+        # grouping (averaging_frequency=k) keeps its k-step semantics;
+        # wrapper.fit's epoch counter is neutralized (the trainer owns
+        # the epoch count)
+        self._group.append(batch)
+        if len(self._group) >= self.wrapper.averaging_frequency:
+            self._flush()
+
+    def _flush(self):
+        if not self._group:
+            return
+        e = self.net.epoch
+        self.wrapper.fit(self._group)
+        self.net.epoch = e
+        self._group = []
+
+    def _on_epoch_data_end(self):
+        self._flush()

@@ -21,6 +21,16 @@ class EarlyStoppingTrainer:
         self.net = net
         self.train_iterator = train_iterator
 
+    def _fit_batch(self, batch):
+        """One training batch; EarlyStoppingParallelTrainer overrides to
+        route through ParallelWrapper. Uses fit_batch so the net's epoch
+        counter stays under THIS trainer's control."""
+        self.net.fit_batch(batch)
+
+    def _on_epoch_data_end(self):
+        """Hook after the epoch's batch loop (parallel trainer flushes
+        its local-SGD group here)."""
+
     def fit(self) -> EarlyStoppingResult:
         cfg = self.config
         net = self.net
@@ -37,10 +47,11 @@ class EarlyStoppingTrainer:
         details = ""
 
         while reason is None:
+            net.epoch = epoch
             if hasattr(self.train_iterator, "reset"):
                 self.train_iterator.reset()
             for batch in self.train_iterator:
-                net.fit(batch if not isinstance(batch, tuple) else batch)
+                self._fit_batch(batch)
                 score = net.score()
                 for c in cfg.iteration_termination_conditions:
                     if c.terminate(score):
@@ -51,6 +62,7 @@ class EarlyStoppingTrainer:
                     break
             if reason:
                 break
+            self._on_epoch_data_end()
 
             if epoch % cfg.evaluate_every_n_epochs == 0:
                 if cfg.score_calculator is not None:

@@ -2,3 +2,8 @@ from deeplearning4j_tpu.eval.evaluation import Evaluation, ConfusionMatrix  # no
 from deeplearning4j_tpu.eval.regression import RegressionEvaluation  # noqa: F401
 from deeplearning4j_tpu.eval.roc import ROC, ROCBinary, ROCMultiClass  # noqa: F401
 from deeplearning4j_tpu.eval.binary import EvaluationBinary  # noqa: F401
+from deeplearning4j_tpu.eval.calibration import EvaluationCalibration  # noqa: F401
+from deeplearning4j_tpu.eval.tools import (  # noqa: F401
+    export_evaluation_calibration_to_html,
+    export_roc_charts_to_html,
+)

@@ -1,0 +1,110 @@
+"""EvaluationCalibration: reliability diagrams, residual plots,
+probability histograms.
+
+Parity: eval/EvaluationCalibration.java — accumulates per-bin counts of
+predicted probability vs empirical accuracy (reliability), |label - p|
+residuals, and predicted-probability histograms; plus expected
+calibration error as the summary scalar."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class EvaluationCalibration:
+    """Accumulate with eval(labels, predictions) per batch
+    (labels one-hot [N, C], predictions probabilities [N, C])."""
+
+    def __init__(self, reliability_bins: int = 10,
+                 histogram_bins: int = 50):
+        self.reliability_bins = reliability_bins
+        self.histogram_bins = histogram_bins
+        self._counts = None        # [C, bins] predictions per bin
+        self._correct = None       # [C, bins] correct predictions per bin
+        self._prob_sum = None      # [C, bins] sum of predicted prob
+        self._residual_hist = None # [bins] |label - p| histogram (all)
+        self._prob_hist = None     # [C, bins] predicted prob histogram
+        self.num_classes = None
+
+    def eval(self, labels, predictions, mask: Optional[np.ndarray] = None):
+        labels = np.asarray(labels, np.float64)
+        p = np.asarray(predictions, np.float64)
+        if labels.ndim != 2:
+            raise ValueError("labels must be one-hot [N, C]")
+        n, c = labels.shape
+        if self._counts is None:
+            self.num_classes = c
+            b = self.reliability_bins
+            self._counts = np.zeros((c, b), np.int64)
+            self._correct = np.zeros((c, b), np.int64)
+            self._prob_sum = np.zeros((c, b), np.float64)
+            self._residual_hist = np.zeros(self.histogram_bins, np.int64)
+            self._prob_hist = np.zeros((c, self.histogram_bins), np.int64)
+        if mask is not None:
+            keep = np.asarray(mask).astype(bool).reshape(-1)
+            labels, p = labels[keep], p[keep]
+            n = labels.shape[0]
+        if n == 0:
+            return self
+        b = self.reliability_bins
+        bins = np.clip((p * b).astype(int), 0, b - 1)       # [N, C]
+        correct = labels > 0.5                              # [N, C]
+        for ci in range(c):
+            np.add.at(self._counts[ci], bins[:, ci], 1)
+            np.add.at(self._correct[ci], bins[:, ci],
+                      correct[:, ci].astype(np.int64))
+            np.add.at(self._prob_sum[ci], bins[:, ci], p[:, ci])
+            hb = np.clip((p[:, ci] * self.histogram_bins).astype(int),
+                         0, self.histogram_bins - 1)
+            np.add.at(self._prob_hist[ci], hb, 1)
+        res = np.abs(labels - p).reshape(-1)
+        rb = np.clip((res * self.histogram_bins).astype(int), 0,
+                     self.histogram_bins - 1)
+        np.add.at(self._residual_hist, rb, 1)
+        return self
+
+    # ------------------------------------------------------------- queries
+    def reliability_info(self, class_idx: int):
+        """(mean predicted prob per bin, empirical frequency per bin,
+        counts per bin) — the reliability diagram
+        (ref getReliabilityDiagram)."""
+        cnt = self._counts[class_idx]
+        safe = np.maximum(cnt, 1)
+        mean_p = self._prob_sum[class_idx] / safe
+        freq = self._correct[class_idx] / safe
+        return mean_p, freq, cnt.copy()
+
+    def expected_calibration_error(self, class_idx: Optional[int] = None
+                                   ) -> float:
+        """ECE = sum_b (n_b / N) |acc_b - conf_b| (macro over classes if
+        class_idx is None)."""
+        idxs = (range(self.num_classes) if class_idx is None
+                else [class_idx])
+        eces = []
+        for ci in idxs:
+            mean_p, freq, cnt = self.reliability_info(ci)
+            total = max(cnt.sum(), 1)
+            eces.append(float(np.sum(cnt / total * np.abs(freq - mean_p))))
+        return float(np.mean(eces))
+
+    def residual_plot(self):
+        """(bin_edges, counts) of |label - p| (ref getResidualPlot)."""
+        edges = np.linspace(0, 1, self.histogram_bins + 1)
+        return edges, self._residual_hist.copy()
+
+    def probability_histogram(self, class_idx: int):
+        """(bin_edges, counts) of predicted P(class) (ref
+        getProbabilityHistogram)."""
+        edges = np.linspace(0, 1, self.histogram_bins + 1)
+        return edges, self._prob_hist[class_idx].copy()
+
+    def stats(self) -> str:
+        lines = ["EvaluationCalibration "
+                 f"(bins={self.reliability_bins}):"]
+        for ci in range(self.num_classes):
+            lines.append(f"  class {ci}: ECE="
+                         f"{self.expected_calibration_error(ci):.4f}")
+        lines.append(f"  macro ECE={self.expected_calibration_error():.4f}")
+        return "\n".join(lines)

@@ -41,6 +41,21 @@ class _BinnedRoc:
         fpr, tpr = self.curve()
         return float(np.trapezoid(tpr, fpr))
 
+    def precision_recall(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(precision, recall) from highest threshold to lowest
+        (ref eval/curves/PrecisionRecallCurve.java)."""
+        pos_cum = np.cumsum(self.pos_hist[::-1])
+        neg_cum = np.cumsum(self.neg_hist[::-1])
+        P = max(int(self.pos_hist.sum()), 1)
+        predicted = pos_cum + neg_cum
+        # no predicted positives -> precision defined as 1.0 (ref
+        # PrecisionRecallCurve semantics)
+        precision = np.where(predicted > 0,
+                             pos_cum / np.maximum(predicted, 1), 1.0)
+        precision = np.concatenate([[1.0], precision])
+        recall = np.concatenate([[0.0], pos_cum / P])
+        return precision, recall
+
 
 class ROC:
     """Binary-problem ROC: labels [N, 1] (0/1) or [N, 2] one-hot; scores are
@@ -69,8 +84,15 @@ class ROC:
     def calculate_auc(self) -> float:
         return self._roc.auc()
 
+    auc = calculate_auc
+
     def get_roc_curve(self):
         return self._roc.curve()
+
+    roc_curve = get_roc_curve
+
+    def precision_recall_curve(self):
+        return self._roc.precision_recall()
 
 
 class ROCBinary:

@@ -137,6 +137,86 @@ class RnnToCnnPreProcessor(InputPreProcessor):
         return None if mask is None else mask.reshape(-1)
 
 
+@dataclass(frozen=True)
+class ZeroMeanPrePreProcessor(InputPreProcessor):
+    """Subtract the per-example mean (ref ZeroMeanPrePreProcessor.java)."""
+
+    def preprocess(self, x):
+        axes = tuple(range(1, x.ndim))
+        return x - jnp.mean(x, axis=axes, keepdims=True)
+
+    def output_type(self, input_type):
+        return input_type
+
+
+@dataclass(frozen=True)
+class UnitVarianceProcessor(InputPreProcessor):
+    """Divide by the per-example std (ref UnitVarianceProcessor.java)."""
+
+    def preprocess(self, x):
+        axes = tuple(range(1, x.ndim))
+        return x / jnp.maximum(jnp.std(x, axis=axes, keepdims=True), 1e-12)
+
+    def output_type(self, input_type):
+        return input_type
+
+
+@dataclass(frozen=True)
+class ZeroMeanAndUnitVariancePreProcessor(InputPreProcessor):
+    """Standardize per example (ref ZeroMeanAndUnitVariancePreProcessor)."""
+
+    def preprocess(self, x):
+        axes = tuple(range(1, x.ndim))
+        m = jnp.mean(x, axis=axes, keepdims=True)
+        s = jnp.maximum(jnp.std(x, axis=axes, keepdims=True), 1e-12)
+        return (x - m) / s
+
+    def output_type(self, input_type):
+        return input_type
+
+
+@dataclass(frozen=True)
+class BinomialSamplingPreProcessor(InputPreProcessor):
+    """Bernoulli-sample activations in [0,1] (ref
+    BinomialSamplingPreProcessor.java — the RBM-era stochastic
+    binarization). Deterministic threshold at 0.5 when no rng is
+    threaded (preprocessors are applied outside the rng plumbing)."""
+
+    def preprocess(self, x):
+        return (x > 0.5).astype(x.dtype)
+
+    def output_type(self, input_type):
+        return input_type
+
+
+class ComposableInputPreProcessor(InputPreProcessor):
+    """Chain of preprocessors applied in order
+    (ref ComposableInputPreProcessor.java)."""
+
+    def __init__(self, *preprocessors: InputPreProcessor):
+        self.preprocessors = list(preprocessors)
+
+    def preprocess(self, x):
+        for p in self.preprocessors:
+            x = p.preprocess(x)
+        return x
+
+    def output_type(self, input_type):
+        for p in self.preprocessors:
+            input_type = p.output_type(input_type)
+        return input_type
+
+    def feed_forward_mask(self, mask, input_type):
+        for p in self.preprocessors:
+            mask = p.feed_forward_mask(mask, input_type)
+        return mask
+
+    def to_dict(self) -> dict:
+        return {"type": "ComposableInputPreProcessor",
+                "preprocessors": [p.to_dict()
+                                  for p in self.preprocessors]}
+
+
 PREPROCESSORS = {
     c.__name__: c
     for c in [
@@ -146,6 +226,10 @@ PREPROCESSORS = {
         FeedForwardToRnnPreProcessor,
         CnnToRnnPreProcessor,
         RnnToCnnPreProcessor,
+        ZeroMeanPrePreProcessor,
+        UnitVarianceProcessor,
+        ZeroMeanAndUnitVariancePreProcessor,
+        BinomialSamplingPreProcessor,
     ]
 }
 
@@ -153,6 +237,9 @@ PREPROCESSORS = {
 def preprocessor_from_dict(d: dict) -> InputPreProcessor:
     d = dict(d)
     kind = d.pop("type")
+    if kind == "ComposableInputPreProcessor":
+        return ComposableInputPreProcessor(
+            *[preprocessor_from_dict(p) for p in d["preprocessors"]])
     return PREPROCESSORS[kind](**d)
 
 

@@ -336,15 +336,22 @@ class ComputationGraph:
                 except StopIteration:
                     break
                 self._last_etl_ms = (time.perf_counter() - _t0) * 1e3
-                ins, labs, fms, lms = _as_multi(batch)
-                self._fit_one(ins, labs, fms, lms)
-                for listener in self.listeners:
-                    listener.iteration_done(self, self.iteration)
+                self.fit_batch(batch)
             self.epoch += 1
             for listener in self.listeners:
                 if hasattr(listener, "on_epoch_end"):
                     listener.on_epoch_end(self)
         return self
+
+    def fit_batch(self, batch):
+        """Train on ONE batch without fit()'s epoch bookkeeping."""
+        if self.params is None:
+            self.init()
+        ins, labs, fms, lms = _as_multi(batch)
+        self._fit_one(ins, labs, fms, lms)
+        for listener in self.listeners:
+            listener.iteration_done(self, self.iteration)
+        return self._score
 
     def _fit_one(self, ins, labs, fms, lms):
         from deeplearning4j_tpu.nn.conf.network import BackpropType

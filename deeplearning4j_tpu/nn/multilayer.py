@@ -357,26 +357,35 @@ class MultiLayerNetwork:
                 except StopIteration:
                     break
                 self._last_etl_ms = (time.perf_counter() - _t0) * 1e3
-                x, y, fm, lm = _as_batch(batch)
-                x = jnp.asarray(x, self.dtype)
-                y = jnp.asarray(y, self.dtype)
-                self._last_batch_size = int(x.shape[0])
-                fm = None if fm is None else jnp.asarray(fm, self.dtype)
-                lm = None if lm is None else jnp.asarray(lm, self.dtype)
-                if (self.conf.backprop_type == BackpropType.TRUNCATED_BPTT
-                        and x.ndim == 3):
-                    loss = self._fit_tbptt(x, y, fm, lm)
-                elif self._use_solver():
-                    loss = self._solver_step(x, y, fm, lm)
-                else:
-                    loss, _ = self._train_step(x, y, fm, lm)
-                for listener in self.listeners:
-                    listener.iteration_done(self, self.iteration)
+                self.fit_batch(batch)
             self.epoch += 1
             for listener in self.listeners:
                 if hasattr(listener, "on_epoch_end"):
                     listener.on_epoch_end(self)
         return self
+
+    def fit_batch(self, batch):
+        """Train on ONE batch without fit()'s epoch bookkeeping (used by
+        the fit loop and the early-stopping trainers, whose epoch counter
+        is their own)."""
+        if self.params is None:
+            self.init()
+        x, y, fm, lm = _as_batch(batch)
+        x = jnp.asarray(x, self.dtype)
+        y = jnp.asarray(y, self.dtype)
+        self._last_batch_size = int(x.shape[0])
+        fm = None if fm is None else jnp.asarray(fm, self.dtype)
+        lm = None if lm is None else jnp.asarray(lm, self.dtype)
+        if (self.conf.backprop_type == BackpropType.TRUNCATED_BPTT
+                and x.ndim == 3):
+            loss = self._fit_tbptt(x, y, fm, lm)
+        elif self._use_solver():
+            loss = self._solver_step(x, y, fm, lm)
+        else:
+            loss, _ = self._train_step(x, y, fm, lm)
+        for listener in self.listeners:
+            listener.iteration_done(self, self.iteration)
+        return loss
 
     def _use_solver(self) -> bool:
         return getattr(self.conf, "optimization_algo",

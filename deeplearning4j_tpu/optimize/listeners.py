@@ -214,3 +214,48 @@ class CheckpointListener:
     def on_epoch_end(self, model):
         if self.every_n_epochs and model.epoch % self.every_n_epochs == 0:
             self._save(model, f"epoch{model.epoch}")
+
+
+class ProfilerListener:
+    """Capture a jax.profiler device trace for iterations
+    [start_iteration, start_iteration + num_iterations) — the op-level
+    tracer SURVEY §5.1 maps to (the reference delegates to the ND4J
+    profiler). View the trace with TensorBoard's profile plugin or
+    xprof; PERF.md documents the xplane aggregation recipe."""
+
+    def __init__(self, log_dir: str, start_iteration: int = 10,
+                 num_iterations: int = 5, log=None):
+        self.log_dir = log_dir
+        self.start = start_iteration
+        self.stop_at = start_iteration + num_iterations
+        self.log = log or (lambda msg: logger.info(msg))
+        self._active = False
+        self._done = False
+        self.trace_dir = None
+
+    def iteration_done(self, model, iteration: int):
+        import jax
+
+        if not self._active and not self._done and iteration >= self.start:
+            # >=, not ==: the counter can jump by k (local-SGD groups,
+            # TBPTT segments)
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+        elif self._active and iteration >= self.stop_at:
+            # force pending device work into the traced window
+            if model.score() is not None:
+                float(model.score())
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+            self.trace_dir = self.log_dir
+            self.log(f"profiler trace written to {self.log_dir}")
+
+    def __del__(self):
+        if getattr(self, "_active", False):
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass

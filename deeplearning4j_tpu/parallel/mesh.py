@@ -8,7 +8,8 @@ component names these rather than inventing its own):
 - ``tp``: tensor parallel — weight matrices sharded, activations gathered
   by XLA-inserted collectives. Consumed by sharding.param_shardings.
 - ``sp``: sequence/context parallel — time dim sharded; consumed by
-  parallel.ring_attention (blockwise ring attention over ICI).
+  parallel.ring_attention.ring_self_attention (blockwise ring attention
+  with K/V ppermute rotation over ICI).
 - ``pp``, ``ep``: reserved axis *names* (pipeline / expert parallel) so
   future components agree on naming; no component consumes them today and
   make_mesh keeps them at size 1 unless explicitly set.

@@ -1,0 +1,113 @@
+"""Ring attention: sequence-parallel exact attention over the `sp` mesh
+axis.
+
+No reference counterpart — the reference's only long-sequence mechanism
+is truncated BPTT (SURVEY §5.7); this is the first-class TPU-native
+long-context component the survey calls for: the sequence axis is
+sharded over `sp`, each shard holds its Q/K/V block, and K/V blocks
+rotate around the ring via `lax.ppermute` (one ICI hop per step) while
+each shard folds the incoming block into a numerically-stable online
+softmax (the blockwise/flash formulation). Peak memory per chip is
+O(T_local^2) instead of O(T^2), and the N-1 permutes overlap with the
+block matmuls under XLA's scheduler.
+
+Entry points:
+- ring_self_attention(q, k, v, mesh, ...): global [B, T, H, D] arrays
+  (T divisible by sp); shards, runs the ring, returns global output.
+- _ring_attention_block: the per-shard body, usable inside a larger
+  shard_map'd step.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _ring_attention_block(q, k, v, *, axis_name: str, causal: bool,
+                          scale: float):
+    """Per-shard ring attention. q/k/v: [B, Tl, H, D] local blocks.
+
+    Online-softmax accumulation per incoming K/V block; K/V rotate
+    shard i -> shard (i+1) % n each step, so after t steps shard i
+    holds the block that originated at shard (i - t) mod n."""
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    B, Tl, H, D = q.shape
+    q_ = jnp.swapaxes(q, 1, 2)          # [B, H, Tq, D]
+    neg = jnp.finfo(jnp.float32).min
+
+    def fold(carry, t):
+        m_prev, l_prev, o_prev, k_cur, v_cur = carry
+        origin = (my - t) % n            # which shard this K/V came from
+        k_ = jnp.swapaxes(k_cur, 1, 2)   # [B, H, Tk, D]
+        v_ = jnp.swapaxes(v_cur, 1, 2)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q_, k_,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = my * Tl + jnp.arange(Tl)          # global q indices
+            k_pos = origin * Tl + jnp.arange(Tl)      # global k indices
+            mask = q_pos[:, None] >= k_pos[None, :]   # [Tq, Tk]
+            s = jnp.where(mask[None, None], s, neg)
+        m_blk = jnp.max(s, axis=-1)                   # [B,H,Tq]
+        m_new = jnp.maximum(m_prev, m_blk)
+        # fully-masked rows keep m = -inf; guard the exp shift
+        shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - shift[..., None])
+        if causal:
+            p = jnp.where(mask[None, None], p, 0.0)
+        corr = jnp.where(jnp.isfinite(m_prev),
+                         jnp.exp(m_prev - shift), 0.0)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1)
+        o_new = (o_prev * corr[..., None]
+                 + jnp.einsum("bhqk,bhkd->bhqd", p,
+                              v_.astype(jnp.float32)))
+        # rotate K/V one hop around the ring (skip after the last fold)
+        k_nxt, v_nxt = jax.lax.cond(
+            t < n - 1,
+            lambda kv: jax.lax.ppermute(
+                kv, axis_name,
+                perm=[(i, (i + 1) % n) for i in range(n)]),
+            lambda kv: kv,
+            (k_cur, v_cur))
+        return (m_new, l_new, o_new, k_nxt, v_nxt), None
+
+    m0 = jnp.full((B, H, Tl), neg, jnp.float32)
+    l0 = jnp.zeros((B, H, Tl), jnp.float32)
+    o0 = jnp.zeros((B, H, Tl, D), jnp.float32)
+    (m, l, o, _, _), _ = jax.lax.scan(
+        fold, (m0, l0, o0, k, v), jnp.arange(n))
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)   # [B, Tq, H, D]
+
+
+def ring_self_attention(q, k, v, mesh: Mesh, *, axis_name: str = "sp",
+                        causal: bool = False,
+                        scale: Optional[float] = None):
+    """Exact multi-head attention with the sequence dim sharded over
+    `axis_name`. q/k/v: [B, T, H, D] with T % mesh.shape[axis_name] == 0.
+    Matches dense softmax(QK^T/sqrt(D))V to float32 accuracy."""
+    if axis_name not in mesh.shape:
+        raise ValueError(f"mesh has no axis '{axis_name}' "
+                         f"(axes: {dict(mesh.shape)})")
+    n = mesh.shape[axis_name]
+    B, T, H, D = q.shape
+    if T % n:
+        raise ValueError(f"sequence length {T} not divisible by "
+                         f"{axis_name}={n}")
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(D))
+    spec = P(None, axis_name, None, None)
+    fn = jax.jit(jax.shard_map(
+        partial(_ring_attention_block, axis_name=axis_name,
+                causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False))
+    sh = NamedSharding(mesh, spec)
+    put = lambda a: jax.device_put(a, sh)
+    return fn(put(q), put(k), put(v))

@@ -1,0 +1,85 @@
+"""Generate the committed golden model-zip regression fixtures.
+
+Run from the repo root:  python tests/fixtures/gen_golden_models.py
+
+The zips + expected-output oracles are committed; the regression test
+(tests/test_regression_golden.py) must load them and predict identically
+FOREVER — the backward-compatibility contract for the serialization
+format (ref: deeplearning4j-core regressiontest/RegressionTest080.java,
+which loads zips produced by old releases)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def main():
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import InputType
+    from deeplearning4j_tpu.nn.conf.graph_conf import GraphBuilder
+    from deeplearning4j_tpu.nn.conf.graph_vertices import (
+        ElementWiseVertex,
+        LastTimeStepVertex,
+    )
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.layers import (
+        BatchNormalization,
+        ConvolutionLayer,
+        DenseLayer,
+        LSTM,
+        OutputLayer,
+        SubsamplingLayer,
+    )
+    from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+
+    rng = np.random.default_rng(99)
+
+    # golden 1: conv+BN+dense MLN, briefly trained (non-initial params,
+    # BN running stats, adam updater state)
+    conf = (NeuralNetConfiguration.Builder().seed(11).updater("adam")
+            .learning_rate(1e-2).weight_init("xavier").list()
+            .layer(ConvolutionLayer(kernel_size=(3, 3), n_out=3,
+                                    activation="relu"))
+            .layer(BatchNormalization())
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=12, activation="tanh"))
+            .layer(OutputLayer(n_out=4, loss="mcxent"))
+            .set_input_type(InputType.convolutional(8, 8, 2))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x = rng.normal(size=(8, 8, 8, 2)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 8)]
+    net.fit([(x, y)] * 3)
+    ModelSerializer.write_model(net, os.path.join(HERE, "golden_mln.zip"))
+    np.savez(os.path.join(HERE, "golden_mln_expected.npz"),
+             x=x, y=np.asarray(net.output(x)))
+
+    # golden 2: two-branch graph with LSTM + elementwise add
+    gb = (GraphBuilder(NeuralNetConfiguration.Builder().seed(12)
+                       .updater("nesterovs").learning_rate(5e-3)
+                       .weight_init("xavier"))
+          .add_inputs("seq")
+          .add_layer("l1", LSTM(n_out=6, activation="tanh"), "seq")
+          .add_layer("l2", LSTM(n_out=6, activation="tanh"), "seq")
+          .add_vertex("sum", ElementWiseVertex(op="add"), "l1", "l2")
+          .add_vertex("last", LastTimeStepVertex(), "sum")
+          .add_layer("out", OutputLayer(n_out=3, loss="mcxent"), "last")
+          .set_outputs("out")
+          .set_input_types(seq=InputType.recurrent(4, 7)))
+    g = ComputationGraph(gb.build()).init()
+    xs = rng.normal(size=(5, 7, 4)).astype(np.float32)
+    ys = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 5)]
+    g.fit([([xs], [ys])] * 2)
+    ModelSerializer.write_model(g, os.path.join(HERE, "golden_graph.zip"))
+    np.savez(os.path.join(HERE, "golden_graph_expected.npz"),
+             x=xs, y=np.asarray(g.output(xs)))
+    print("golden fixtures written to", HERE)
+
+
+if __name__ == "__main__":
+    main()

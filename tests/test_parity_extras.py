@@ -1,0 +1,236 @@
+"""Tests for the round-3 parity batch: calibration + HTML exports, YAML
+serde, extra preprocessors, golden regression zips, parallel early
+stopping, profiler listener."""
+
+import os
+
+import numpy as np
+import pytest
+
+FIX = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+# ----------------------------------------------------------- calibration
+
+def test_evaluation_calibration(rng):
+    from deeplearning4j_tpu.eval import EvaluationCalibration
+
+    n, c = 2000, 3
+    # well-calibrated predictions: sample labels FROM the predicted dist
+    logits = rng.normal(size=(n, c))
+    p = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+    labels = np.zeros((n, c), np.float32)
+    for i in range(n):
+        labels[i, rng.choice(c, p=p[i])] = 1.0
+    ec = EvaluationCalibration(reliability_bins=10)
+    ec.eval(labels[:1000], p[:1000])
+    ec.eval(labels[1000:], p[1000:])   # accumulates over batches
+    ece = ec.expected_calibration_error()
+    assert 0.0 <= ece < 0.08, ece
+
+    # badly calibrated: overconfident constant prediction
+    bad = np.full((n, c), 1e-3)
+    bad[:, 0] = 1 - 2e-3
+    ec2 = EvaluationCalibration()
+    ec2.eval(labels, bad)
+    assert ec2.expected_calibration_error() > ece
+    mean_p, freq, cnt = ec.reliability_info(0)
+    assert cnt.sum() == n
+    edges, hist = ec.residual_plot()
+    assert hist.sum() == n * c
+    assert "ECE" in ec.stats()
+
+
+def test_roc_and_calibration_html_export(tmp_path, rng):
+    from deeplearning4j_tpu.eval import (
+        EvaluationCalibration,
+        ROC,
+        export_evaluation_calibration_to_html,
+        export_roc_charts_to_html,
+    )
+
+    n = 500
+    scores = rng.random(n)
+    labels01 = (rng.random(n) < scores).astype(np.float32)
+    roc = ROC()
+    roc.eval(labels01[:, None], scores[:, None])
+    page = export_roc_charts_to_html(roc, str(tmp_path / "roc.html"))
+    assert "AUC=" in page and (tmp_path / "roc.html").exists()
+    assert roc.calculate_auc() > 0.7
+
+    y = np.stack([1 - labels01, labels01], 1)
+    p = np.stack([1 - scores, scores], 1)
+    ec = EvaluationCalibration()
+    ec.eval(y, p)
+    page2 = export_evaluation_calibration_to_html(
+        ec, str(tmp_path / "cal.html"))
+    assert "reliability class" in page2
+
+
+# ------------------------------------------------------------------ YAML
+
+def test_yaml_round_trip_mln():
+    from deeplearning4j_tpu import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import InputType
+    from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    conf = (NeuralNetConfiguration.Builder().updater("adam").seed(5)
+            .list()
+            .layer(DenseLayer(n_out=4, activation="relu"))
+            .layer(OutputLayer(n_out=2, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(3)).build())
+    rt = MultiLayerConfiguration.from_yaml(conf.to_yaml())
+    assert rt.to_json() == conf.to_json()
+
+
+def test_yaml_round_trip_graph():
+    from deeplearning4j_tpu import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import InputType
+    from deeplearning4j_tpu.nn.conf.graph_conf import (
+        ComputationGraphConfiguration,
+        GraphBuilder,
+    )
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    conf = (GraphBuilder(NeuralNetConfiguration.Builder().updater("sgd"))
+            .add_inputs("x")
+            .add_layer("h", DenseLayer(n_out=4), "x")
+            .add_layer("o", OutputLayer(n_out=2, loss="mcxent"), "h")
+            .set_outputs("o")
+            .set_input_types(x=InputType.feed_forward(3)).build())
+    rt = ComputationGraphConfiguration.from_yaml(conf.to_yaml())
+    assert rt.to_json() == conf.to_json()
+
+
+# -------------------------------------------------------- preprocessors
+
+def test_normalization_preprocessors(rng):
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.conf.preprocessors import (
+        BinomialSamplingPreProcessor,
+        ComposableInputPreProcessor,
+        UnitVarianceProcessor,
+        ZeroMeanAndUnitVariancePreProcessor,
+        ZeroMeanPrePreProcessor,
+        preprocessor_from_dict,
+    )
+
+    x = jnp.asarray(rng.normal(2.0, 3.0, size=(4, 10)).astype(np.float32))
+    zm = ZeroMeanPrePreProcessor().preprocess(x)
+    np.testing.assert_allclose(np.asarray(zm).mean(1), 0, atol=1e-5)
+    uv = UnitVarianceProcessor().preprocess(x)
+    np.testing.assert_allclose(np.asarray(uv).std(1), 1, rtol=1e-4)
+    zs = ZeroMeanAndUnitVariancePreProcessor().preprocess(x)
+    np.testing.assert_allclose(np.asarray(zs).mean(1), 0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(zs).std(1), 1, rtol=1e-4)
+    bs = BinomialSamplingPreProcessor().preprocess(
+        jnp.asarray([[0.2, 0.9]]))
+    np.testing.assert_array_equal(np.asarray(bs), [[0.0, 1.0]])
+
+    comp = ComposableInputPreProcessor(
+        ZeroMeanPrePreProcessor(), UnitVarianceProcessor())
+    y = comp.preprocess(x)
+    np.testing.assert_allclose(np.asarray(y).std(1), 1, rtol=1e-4)
+    rt = preprocessor_from_dict(comp.to_dict())
+    np.testing.assert_allclose(np.asarray(rt.preprocess(x)),
+                               np.asarray(y), rtol=1e-6)
+
+
+# --------------------------------------------------- golden regression
+
+def _fixture(name):
+    path = os.path.join(FIX, name)
+    if not os.path.exists(path):
+        pytest.skip(f"fixture {name} missing")
+    return path
+
+
+def test_golden_mln_regression():
+    """Committed zips must load + predict identically forever
+    (ref RegressionTest080.java)."""
+    from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+
+    net = ModelSerializer.restore_multi_layer_network(
+        _fixture("golden_mln.zip"))
+    exp = np.load(_fixture("golden_mln_expected.npz"))
+    np.testing.assert_allclose(np.asarray(net.output(exp["x"])),
+                               exp["y"], rtol=1e-5, atol=1e-6)
+
+
+def test_golden_graph_regression():
+    from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+
+    net = ModelSerializer.restore_computation_graph(
+        _fixture("golden_graph.zip"))
+    exp = np.load(_fixture("golden_graph_expected.npz"))
+    np.testing.assert_allclose(np.asarray(net.output(exp["x"])),
+                               exp["y"], rtol=1e-5, atol=1e-6)
+
+
+# -------------------------------------------- parallel early stopping
+
+def test_early_stopping_parallel_trainer(rng):
+    import jax
+
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.earlystopping import (
+        EarlyStoppingConfiguration,
+        EarlyStoppingParallelTrainer,
+        InMemoryModelSaver,
+        MaxEpochsTerminationCondition,
+    )
+    from deeplearning4j_tpu.nn.conf import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.parallel import make_mesh
+
+    ds = jax.devices("cpu")
+    if len(ds) < 2:
+        pytest.skip("need 2 cpu devices")
+    conf = (NeuralNetConfiguration.Builder().seed(3).updater("sgd")
+            .learning_rate(0.1).weight_init("xavier").list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=2, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = rng.normal(size=(16, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+    es_conf = (EarlyStoppingConfiguration.Builder()
+               .model_saver(InMemoryModelSaver())
+               .epoch_termination_conditions(
+                   MaxEpochsTerminationCondition(3))
+               .build())
+    trainer = EarlyStoppingParallelTrainer(
+        es_conf, net, [(x, y)] * 4,
+        mesh=make_mesh(dp=2, devices=ds[:2]))
+    result = trainer.fit()
+    assert result.total_epochs <= 3
+    assert result.best_model is not None
+    assert np.isfinite(result.best_model_score)
+
+
+# ------------------------------------------------------------ profiler
+
+def test_profiler_listener(tmp_path, rng):
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.optimize.listeners import ProfilerListener
+
+    conf = (NeuralNetConfiguration.Builder().seed(1).updater("sgd")
+            .learning_rate(0.1).list()
+            .layer(DenseLayer(n_out=4))
+            .layer(OutputLayer(n_out=2, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(3)).build())
+    net = MultiLayerNetwork(conf).init()
+    log_dir = str(tmp_path / "trace")
+    net.listeners.append(ProfilerListener(log_dir, start_iteration=2,
+                                          num_iterations=2))
+    x = rng.normal(size=(8, 3)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+    net.fit([(x, y)] * 6)
+    import glob
+
+    assert glob.glob(os.path.join(log_dir, "**", "*.xplane.pb"),
+                     recursive=True), "no xplane trace written"
