@@ -75,7 +75,9 @@ def load_mnist(train: bool = True, synthetic_fallback: bool = True):
     img_raw = _fetch(_MNIST_URLS[f"{kind}_images"], f"mnist_{kind}_images.gz")
     lab_raw = _fetch(_MNIST_URLS[f"{kind}_labels"], f"mnist_{kind}_labels.gz")
     if img_raw is not None and lab_raw is not None:
-        imgs = parse_idx(gzip.decompress(img_raw)).astype(np.float32) / 255.0
+        from deeplearning4j_tpu.native import u8_to_f32
+
+        imgs = u8_to_f32(parse_idx(gzip.decompress(img_raw)))  # /255 fused
         labs = parse_idx(gzip.decompress(lab_raw))
         x = imgs[..., None]
         y = np.eye(10, dtype=np.float32)[labs]
@@ -154,15 +156,19 @@ class CifarDataSetIterator(ListDataSetIterator):
         files = ([f"data_batch_{i}" for i in range(1, 6)] if train
                  else ["test_batch"])
         if os.path.isdir(root):
+            from deeplearning4j_tpu.native import chw_u8_to_hwc_f32
+
             xs, ys = [], []
             for f in files:
                 with open(os.path.join(root, f), "rb") as fh:
                     d = pickle.load(fh, encoding="bytes")
-                xs.append(np.asarray(d[b"data"], np.float32) / 255.0)
+                xs.append(np.asarray(d[b"data"], np.uint8))
                 ys.append(np.asarray(d[b"labels"]))
-            x = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+            # CHW pickle layout -> HWC f32, normalization fused (native)
+            x = chw_u8_to_hwc_f32(
+                np.concatenate(xs).reshape(-1, 3, 32, 32))
             y = np.eye(10, dtype=np.float32)[np.concatenate(ys)]
-            return np.ascontiguousarray(x), y
+            return x, y
         if not synthetic_fallback:
             raise RuntimeError(f"CIFAR-10 not cached under {root}")
         n = 4096 if train else 512
@@ -172,3 +178,56 @@ class CifarDataSetIterator(ListDataSetIterator):
         x = (templates[labs] * 0.5
              + rng.normal(scale=0.3, size=(n, 32, 32, 3))).astype(np.float32)
         return x, np.eye(10, dtype=np.float32)[labs]
+
+
+class LFWDataSetIterator(ListDataSetIterator):
+    """LFW faces iterator (ref: datasets/iterator/impl/
+    LFWDataSetIterator.java + fetchers/LFWDataFetcher.java). The real
+    dataset needs network egress; with no cache present this generates
+    deterministic synthetic face-shaped data (same fallback contract as
+    CifarDataSetIterator) — shape parity [B, H, W, 3] + one-hot labels."""
+
+    def __init__(self, batch_size: int, num_examples: int = 200,
+                 image_shape=(64, 64, 3), num_labels: int = 10,
+                 train: bool = True, seed: int = 42):
+        h, w, c = image_shape
+        rng = np.random.default_rng(seed + (0 if train else 1))
+        labels = rng.integers(0, num_labels, num_examples)
+        x = np.zeros((num_examples, h, w, c), np.float32)
+        for i, lab in enumerate(labels):
+            # label-dependent "face": oval + eye blobs, lightly jittered
+            yy, xx = np.mgrid[0:h, 0:w]
+            cy, cx = h / 2 + lab % 3, w / 2 - lab % 2
+            oval = (((yy - cy) / (h * 0.35)) ** 2
+                    + ((xx - cx) / (w * 0.28)) ** 2) < 1.0
+            x[i, :, :, :] = rng.normal(0.1, 0.05, (h, w, c))
+            x[i, oval] += 0.5 + 0.03 * lab
+        y = np.eye(num_labels, dtype=np.float32)[labels]
+        super().__init__(DataSet(x, y), batch_size)
+
+
+class CurvesDataSetIterator(ListDataSetIterator):
+    """Synthetic 'curves' autoencoder dataset (ref: datasets/iterator/
+    impl/CurvesDataSetIterator.java — the deep-autoencoder benchmark
+    input; the original served a fixed binary file). Deterministic
+    synthetic parametric curves rasterized to 28x28, features==labels
+    (autoencoder convention)."""
+
+    def __init__(self, batch_size: int, num_examples: int = 200,
+                 seed: int = 17):
+        rng = np.random.default_rng(seed)
+        side = 28
+        x = np.zeros((num_examples, side * side), np.float32)
+        t = np.linspace(0, 1, 60)
+        for i in range(num_examples):
+            # random cubic Bezier curve through the unit square
+            pts = rng.random((4, 2))
+            b = ((1 - t)[:, None] ** 3 * pts[0]
+                 + 3 * ((1 - t) ** 2 * t)[:, None] * pts[1]
+                 + 3 * ((1 - t) * t ** 2)[:, None] * pts[2]
+                 + (t ** 3)[:, None] * pts[3])
+            ij = np.clip((b * (side - 1)).astype(int), 0, side - 1)
+            img = np.zeros((side, side), np.float32)
+            img[ij[:, 1], ij[:, 0]] = 1.0
+            x[i] = img.reshape(-1)
+        super().__init__(DataSet(x, x.copy()), batch_size)

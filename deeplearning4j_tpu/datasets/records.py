@@ -64,6 +64,27 @@ class CSVRecordReader(RecordReader):
         finally:
             fh.close()
 
+    def to_matrix(self):
+        """Whole-file all-numeric fast path: native C++ CSV->f32 parse
+        (native/dl4j_tpu_native.cpp). Returns None when the content
+        needs the general row path (non-numeric cells, quoting, or
+        skip_lines)."""
+        if self.skip_lines:
+            return None
+        try:
+            from deeplearning4j_tpu.native import parse_csv_f32
+
+            if self.path:
+                with open(self.path, "rb") as f:
+                    data = f.read()
+            else:
+                data = self.text.encode()
+            if self.quotechar.encode() in data:
+                return None
+            return parse_csv_f32(data, self.delimiter)
+        except ValueError:
+            return None
+
 
 class CollectionRecordReader(RecordReader):
     """In-memory records (ref CollectionRecordReader.java)."""
@@ -140,10 +161,14 @@ class RecordReaderDataSetIterator(DataSetIterator):
         self.label_index_to = label_index_to
         self._it = None
         self._buf: Optional[DataSet] = None
+        self._native_checked = False
+        self._native_batches = None
 
     def reset(self):
         self._it = None
         self._buf = None
+        self._native_checked = False
+        self._native_batches = None
 
     def _rows(self):
         if self._it is None:
@@ -165,8 +190,28 @@ class RecordReaderDataSetIterator(DataSetIterator):
             labels = _one_hot(labels[:, 0], self.num_classes)
         return DataSet(feats, labels)
 
+    def _try_native(self):
+        """One-shot whole-file native parse; leaves per-row iteration as
+        the fallback. Populates a batch queue."""
+        if self._native_checked:
+            return
+        self._native_checked = True
+        m = getattr(self.reader, "to_matrix", lambda: None)()
+        if m is None or m.size == 0:
+            return
+        self._native_batches = [
+            m[i:i + self.batch_size]
+            for i in range(0, m.shape[0], self.batch_size)]
+
     def has_next(self) -> bool:
         if self._buf is not None:
+            return True
+        self._try_native()
+        if self._native_batches is not None:
+            if not self._native_batches:
+                return False
+            block = self._native_batches.pop(0)
+            self._buf = self._split([list(r) for r in block])
             return True
         rows = []
         for row in self._rows():

@@ -1,0 +1,163 @@
+"""ctypes bindings for the native host data-path library.
+
+The device compute path is XLA; the HOST pipeline stages that the
+reference implements natively (DataVec parsing, ND4J buffer fill —
+SURVEY L0/L2) are native here too: native/dl4j_tpu_native.cpp provides
+fast CSV->f32 parsing and fused u8->f32 (de)normalization/layout ops.
+
+The library is compiled on demand with g++ (no pybind11 in this image;
+plain C ABI + ctypes) and cached beside the source. Every entry point
+has a NumPy fallback, so the package works — just slower — without a
+toolchain. `available()` reports which path is active.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_NAME = "libdl4j_tpu_native.so"
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    src = os.path.join(_SRC_DIR, "dl4j_tpu_native.cpp")
+    if not os.path.exists(src):
+        return None
+    out = os.path.join(_SRC_DIR, _LIB_NAME)
+    if not os.path.exists(out) or (os.path.getmtime(out)
+                                   < os.path.getmtime(src)):
+        try:
+            subprocess.run(
+                ["sh", os.path.join(_SRC_DIR, "build.sh"), out],
+                check=True, capture_output=True, timeout=120)
+        except Exception:
+            return None
+    try:
+        lib = ctypes.CDLL(out)
+    except OSError:
+        return None
+    lib.dl4j_parse_csv_f32.restype = ctypes.c_int
+    lib.dl4j_parse_csv_f32.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_char,
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+    lib.dl4j_u8_to_f32.restype = None
+    lib.dl4j_u8_to_f32.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64, ctypes.c_float, ctypes.c_float]
+    lib.dl4j_chw_u8_to_hwc_f32.restype = None
+    lib.dl4j_chw_u8_to_hwc_f32.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_float, ctypes.c_float]
+    if lib.dl4j_native_abi_version() != 1:
+        return None
+    return lib
+
+
+def _get() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    with _lock:
+        if not _tried:
+            _lib = _build_and_load()
+            _tried = True
+    return _lib
+
+
+def available() -> bool:
+    return _get() is not None
+
+
+def parse_csv_f32(text, delimiter: str = ",") -> np.ndarray:
+    """Parse an all-numeric delimited text into a float32 [N, C] array.
+    '#'-comment and blank lines are skipped. Raises ValueError on ragged
+    or non-numeric input (both paths)."""
+    if isinstance(text, str):
+        text = text.encode()
+    lib = _get()
+    if lib is None:
+        return _parse_csv_fallback(text, delimiter)
+    # capacity: numbers can't be denser than 2 bytes each ("1,1,...")
+    max_vals = max(len(text) // 2 + 16, 16)
+    out = np.empty(max_vals, np.float32)
+    n_rows = ctypes.c_int64()
+    n_cols = ctypes.c_int64()
+    rc = lib.dl4j_parse_csv_f32(
+        text, len(text), delimiter.encode()[0:1] or b",",
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), max_vals,
+        ctypes.byref(n_rows), ctypes.byref(n_cols))
+    if rc == -2:
+        raise ValueError("ragged rows in CSV input")
+    if rc == -3:
+        raise ValueError("non-numeric value in CSV input")
+    if rc != 0:
+        raise ValueError(f"native CSV parse failed (code {rc})")
+    r, c = n_rows.value, n_cols.value
+    return out[:r * c].reshape(r, c).copy()
+
+
+def _parse_csv_fallback(data: bytes, delimiter: str) -> np.ndarray:
+    rows = []
+    ncols = None
+    for line in data.decode().splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        vals = [float(v) for v in line.split(delimiter)]
+        if ncols is None:
+            ncols = len(vals)
+        elif len(vals) != ncols:
+            raise ValueError("ragged rows in CSV input")
+        rows.append(vals)
+    if not rows:
+        return np.zeros((0, 0), np.float32)
+    return np.asarray(rows, np.float32)
+
+
+def u8_to_f32(src: np.ndarray, scale: float = 1.0 / 255.0,
+              shift: float = 0.0) -> np.ndarray:
+    """u8 -> f32 affine normalize, single fused pass."""
+    src = np.ascontiguousarray(src, np.uint8)
+    lib = _get()
+    if lib is None:
+        return src.astype(np.float32) * scale + shift
+    dst = np.empty(src.shape, np.float32)
+    lib.dl4j_u8_to_f32(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        dst.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        src.size, scale, shift)
+    return dst
+
+
+def chw_u8_to_hwc_f32(src: np.ndarray, scale: float = 1.0 / 255.0,
+                      shift: float = 0.0) -> np.ndarray:
+    """[N, C, H, W] u8 -> [N, H, W, C] f32 with fused normalization
+    (the CIFAR-pickle layout fix-up)."""
+    src = np.ascontiguousarray(src, np.uint8)
+    if src.ndim != 4:
+        raise ValueError(f"expected [N, C, H, W], got shape {src.shape}")
+    n, c, h, w = src.shape
+    lib = _get()
+    if lib is None:
+        return (np.transpose(src, (0, 2, 3, 1)).astype(np.float32)
+                * scale + shift)
+    dst = np.empty((n, h, w, c), np.float32)
+    lib.dl4j_chw_u8_to_hwc_f32(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        dst.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n, c, h, w, scale, shift)
+    return dst

@@ -2,6 +2,7 @@ from deeplearning4j_tpu.stats.report import StatsReport  # noqa: F401
 from deeplearning4j_tpu.stats.storage import (  # noqa: F401
     FileStatsStorage,
     InMemoryStatsStorage,
+    RemoteStatsStorageRouter,
     StatsStorage,
 )
 from deeplearning4j_tpu.stats.listener import StatsListener  # noqa: F401

@@ -154,6 +154,29 @@ class UIServer:
         server = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                # remote stats receiver (ref RemoteReceiverModule):
+                # RemoteStatsStorageRouter POSTs StatsReport JSON here
+                from deeplearning4j_tpu.stats.report import StatsReport
+
+                try:
+                    if self.path.rstrip("/") != "/remote" \
+                            or server._storage is None:
+                        raise ValueError(f"no receiver at {self.path}")
+                    n = int(self.headers.get("Content-Length", 0))
+                    report = StatsReport.from_json(
+                        self.rfile.read(n).decode())
+                    server._storage.put_report(report)
+                    body = b"{}"
+                    self.send_response(200)
+                except Exception as e:
+                    body = str(e).encode()
+                    self.send_response(400)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
                 try:
                     if server._storage is None:

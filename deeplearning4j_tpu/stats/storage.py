@@ -101,3 +101,45 @@ class FileStatsStorage(StatsStorage):
     def close(self) -> None:
         with self._lock:
             self._fh.close()
+
+
+class RemoteStatsStorageRouter(StatsStorage):
+    """POSTs reports as JSON to a remote UIServer's /remote endpoint
+    (ref: deeplearning4j-core api/storage/impl/
+    RemoteUIStatsStorageRouter.java:33 -> RemoteReceiverModule). Write
+    path only; reads raise (query the receiving server instead)."""
+
+    def __init__(self, url: str, timeout: float = 10.0,
+                 retry_count: int = 3):
+        if not url.rstrip("/").endswith("/remote"):
+            url = url.rstrip("/") + "/remote"
+        self.url = url
+        self.timeout = timeout
+        self.retry_count = retry_count
+
+    def put_report(self, report: StatsReport) -> None:
+        import urllib.request
+
+        body = report.to_json().encode()
+        req = urllib.request.Request(
+            self.url, data=body,
+            headers={"Content-Type": "application/json"})
+        last = None
+        for _ in range(max(1, self.retry_count)):
+            try:
+                urllib.request.urlopen(req, timeout=self.timeout)
+                self._notify(report)
+                return
+            except Exception as e:   # noqa: BLE001 - retried
+                last = e
+        raise IOError(f"failed to POST stats report to {self.url}: {last}")
+
+    def session_ids(self):
+        raise NotImplementedError(
+            "RemoteStatsStorageRouter is write-only; query the receiving "
+            "UIServer's storage")
+
+    def reports(self, session_id):
+        raise NotImplementedError(
+            "RemoteStatsStorageRouter is write-only; query the receiving "
+            "UIServer's storage")

@@ -73,6 +73,54 @@ class ZooModel:
         p = os.path.join(root, f"{type(self).__name__.lower()}.zip")
         return p if os.path.exists(p) else None
 
+    # checksum registry for downloaded/dropped pretrained zips
+    # (ref ZooModel.java:40-81 pretrainedChecksum): subclasses may map
+    # pretrained name -> (url, md5); file drops are always accepted.
+    PRETRAINED = {}
+
+    def pretrained_url(self, kind: str = "imagenet"):
+        entry = self.PRETRAINED.get(kind)
+        return entry[0] if entry else None
+
+    def pretrained_checksum(self, kind: str = "imagenet"):
+        entry = self.PRETRAINED.get(kind)
+        return entry[1] if entry else None
+
+    def init_pretrained(self, kind: str = "imagenet",
+                        path: Optional[str] = None):
+        """Fetch-or-load pretrained weights with md5 verification
+        (ref ZooModel.initPretrained :40-81). In this offline
+        environment the 'download' step is a cache lookup; a corrupt
+        cached file fails the checksum exactly like the reference."""
+        import hashlib
+        import urllib.request
+
+        path = path or self.pretrained_path()
+        if path is None:
+            url = self.pretrained_url(kind)
+            if url is None:
+                raise FileNotFoundError(
+                    f"No pretrained weights registered for "
+                    f"{type(self).__name__} ({kind}) and none cached; "
+                    "place a model zip under $DL4J_TPU_PRETRAINED_DIR")
+            root = os.environ.get(
+                "DL4J_TPU_PRETRAINED_DIR",
+                os.path.expanduser("~/.deeplearning4j_tpu"))
+            os.makedirs(root, exist_ok=True)
+            path = os.path.join(
+                root, f"{type(self).__name__.lower()}_{kind}.zip")
+            urllib.request.urlretrieve(url, path)
+        expect = self.pretrained_checksum(kind)
+        if expect is not None:
+            with open(path, "rb") as f:
+                got = hashlib.md5(f.read()).hexdigest()
+            if got != expect:
+                os.remove(path)
+                raise IOError(
+                    f"pretrained checksum mismatch for {path}: "
+                    f"{got} != {expect} (corrupt download removed)")
+        return self.load_pretrained(path)
+
     def load_pretrained(self, path: Optional[str] = None):
         from deeplearning4j_tpu.util.model_guesser import ModelGuesser
 

@@ -360,15 +360,15 @@ class InceptionResNetV1(ZooModel):
                 [[(32, (1, 1))], [(32, (1, 1)), (32, (3, 3))],
                  [(32, (1, 1)), (32, (3, 3)), (32, (3, 3))]], 256)
         x = _conv_bn(gb, "redA", x, 512, (3, 3), stride=(2, 2))
-        # 5x inception-resnet-B (reference: 10)
-        for i in range(5):
+        # 10x inception-resnet-B (ref InceptionResNetHelper)
+        for i in range(10):
             x = self._res_block(
                 gb, f"irB{i}", x,
                 [[(64, (1, 1))], [(64, (1, 1)), (64, (1, 7)), (64, (7, 1))]],
                 512, scale=0.10)
         x = _conv_bn(gb, "redB", x, 896, (3, 3), stride=(2, 2))
-        # 3x inception-resnet-C (reference: 5)
-        for i in range(3):
+        # 5x inception-resnet-C (ref InceptionResNetHelper)
+        for i in range(5):
             x = self._res_block(
                 gb, f"irC{i}", x,
                 [[(96, (1, 1))], [(96, (1, 1)), (96, (1, 3)), (96, (3, 1))]],

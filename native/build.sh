@@ -1,0 +1,9 @@
+#!/bin/sh
+# Build the native host data-path library.
+# Usage: native/build.sh [output.so]
+set -e
+HERE="$(cd "$(dirname "$0")" && pwd)"
+OUT="${1:-$HERE/libdl4j_tpu_native.so}"
+${CXX:-g++} -O3 -march=native -shared -fPIC -std=c++17 \
+    -o "$OUT" "$HERE/dl4j_tpu_native.cpp"
+echo "built $OUT"

@@ -1,0 +1,90 @@
+// Native host-side data-path kernels.
+//
+// Parity role: the reference's host data plumbing is native (DataVec's
+// record readers feed ND4J buffers created in libnd4j; see SURVEY L0/L2).
+// The TPU build keeps device compute in XLA but gives the HOST pipeline
+// the same native treatment: parsing and image normalization are the two
+// CPU-bound stages between storage and jax.device_put, and both are
+// memory-bandwidth problems C++ handles well.
+//
+// Exposed via ctypes (no pybind11 in this image): plain C ABI, caller
+// allocates outputs.
+//
+// Build: native/build.sh (g++ -O3 -shared -fPIC).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+extern "C" {
+
+// Parse a delimited all-numeric text buffer into float32 row-major
+// [n_rows, n_cols]. Returns 0 on success; negative error codes:
+//   -1 output capacity exceeded; -2 ragged rows; -3 bad number.
+// Blank lines and lines starting with '#' are skipped. `out` must hold
+// max_vals floats. n_rows/n_cols are outputs.
+int dl4j_parse_csv_f32(const char* buf, int64_t len, char delim,
+                       float* out, int64_t max_vals,
+                       int64_t* n_rows, int64_t* n_cols) {
+    int64_t rows = 0, cols = -1, count = 0;
+    const char* p = buf;
+    const char* end = buf + len;
+    while (p < end) {
+        // skip blank / comment lines
+        if (*p == '\n' || *p == '\r') { ++p; continue; }
+        if (*p == '#') { while (p < end && *p != '\n') ++p; continue; }
+        int64_t row_cols = 0;
+        while (p < end && *p != '\n' && *p != '\r') {
+            char* next = nullptr;
+            double v = strtod(p, &next);
+            if (next == p) return -3;
+            if (count >= max_vals) return -1;
+            out[count++] = static_cast<float>(v);
+            ++row_cols;
+            p = next;
+            while (p < end && (*p == ' ' || *p == '\t')) ++p;
+            if (p < end && *p == delim) {
+                ++p;
+                while (p < end && (*p == ' ' || *p == '\t')) ++p;
+            }
+        }
+        if (cols < 0) cols = row_cols;
+        else if (row_cols != cols) return -2;
+        ++rows;
+    }
+    *n_rows = rows;
+    *n_cols = cols < 0 ? 0 : cols;
+    return 0;
+}
+
+// u8 image bytes -> f32 with affine transform (x * scale + shift):
+// the MNIST/CIFAR normalization step, single pass.
+void dl4j_u8_to_f32(const uint8_t* src, float* dst, int64_t n,
+                    float scale, float shift) {
+    for (int64_t i = 0; i < n; ++i) {
+        dst[i] = static_cast<float>(src[i]) * scale + shift;
+    }
+}
+
+// Interleaved channel-major (CHW) u8 -> channel-last (HWC) f32 with
+// normalization — the CIFAR pickle layout fix-up fused with the cast.
+void dl4j_chw_u8_to_hwc_f32(const uint8_t* src, float* dst,
+                            int64_t images, int64_t c, int64_t h,
+                            int64_t w, float scale, float shift) {
+    const int64_t plane = h * w;
+    for (int64_t n = 0; n < images; ++n) {
+        const uint8_t* s = src + n * c * plane;
+        float* d = dst + n * c * plane;
+        for (int64_t ch = 0; ch < c; ++ch) {
+            const uint8_t* sp = s + ch * plane;
+            for (int64_t px = 0; px < plane; ++px) {
+                d[px * c + ch] =
+                    static_cast<float>(sp[px]) * scale + shift;
+            }
+        }
+    }
+}
+
+int dl4j_native_abi_version() { return 1; }
+
+}  // extern "C"

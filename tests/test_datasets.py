@@ -150,3 +150,21 @@ def test_mnist_end_to_end_training():
     for b in test:
         ev.eval(b.labels, np.asarray(net.output(b.features)))
     assert ev.accuracy() > 0.9, ev.stats()
+
+
+def test_lfw_and_curves_iterators():
+    from deeplearning4j_tpu.datasets.fetchers import (
+        CurvesDataSetIterator,
+        LFWDataSetIterator,
+    )
+
+    lfw = LFWDataSetIterator(batch_size=16, num_examples=48)
+    b = next(iter(lfw))
+    assert b.features.shape == (16, 64, 64, 3)
+    assert b.labels.shape == (16, 10)
+    assert len(list(lfw)) == 3
+
+    cur = CurvesDataSetIterator(batch_size=20, num_examples=40)
+    b = next(iter(cur))
+    assert b.features.shape == (20, 784)
+    np.testing.assert_array_equal(b.features, b.labels)  # autoencoder

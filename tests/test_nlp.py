@@ -173,3 +173,30 @@ def test_stopwords_preprocessor():
         StopWordsPreProcessor(base=CommonPreprocessor()))
     toks = tf.create("The cat and the dog!").get_tokens()
     assert toks == ["cat", "dog"]
+
+
+def test_moving_window_iterator():
+    from deeplearning4j_tpu.nlp.sentence_iterator import MovingWindowIterator
+    from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+
+    wins = list(MovingWindowIterator(
+        ["the quick brown fox"], DefaultTokenizerFactory(),
+        window_size=3))
+    assert len(wins) == 4
+    assert wins[0]["words"] == ["<s>", "the", "quick"]
+    assert wins[0]["focus"] == "the"
+    assert wins[-1]["words"] == ["brown", "fox", "</s>"]
+    import pytest as _pt
+
+    with _pt.raises(ValueError, match="odd"):
+        list(MovingWindowIterator([], DefaultTokenizerFactory(), 4))
+
+
+def test_file_sentence_iterator(tmp_path):
+    from deeplearning4j_tpu.nlp.sentence_iterator import FileSentenceIterator
+
+    (tmp_path / "a.txt").write_text("hello world\n\nsecond line\n")
+    (tmp_path / "b.txt").write_text("third\n")
+    it = FileSentenceIterator(str(tmp_path))
+    assert list(it) == ["hello world", "second line", "third"]
+    assert list(it) == ["hello world", "second line", "third"]  # re-iter

@@ -144,3 +144,59 @@ def test_in_memory_sequence_reader():
     b = next(iter(it))
     assert b.features.shape == (2, 2, 1)
     assert b.labels_mask[1, 1] == 0.0
+
+
+def test_native_csv_parser_matches_fallback(tmp_path):
+    from deeplearning4j_tpu import native
+
+    text = "# header comment\n1.5,2,3\n-4,5e-2,6\n\n7,8,9\n"
+    arr = native.parse_csv_f32(text)
+    expect = np.asarray([[1.5, 2, 3], [-4, 0.05, 6], [7, 8, 9]],
+                        np.float32)
+    np.testing.assert_allclose(arr, expect, rtol=1e-6)
+    np.testing.assert_allclose(
+        native._parse_csv_fallback(text.encode(), ","), expect,
+        rtol=1e-6)
+    with pytest.raises(ValueError, match="ragged"):
+        native.parse_csv_f32("1,2\n3\n")
+    with pytest.raises(ValueError, match="numeric|parse"):
+        native.parse_csv_f32("1,abc\n")
+
+
+def test_native_u8_kernels():
+    from deeplearning4j_tpu import native
+
+    src = np.arange(256, dtype=np.uint8)
+    out = native.u8_to_f32(src)
+    np.testing.assert_allclose(out, src.astype(np.float32) / 255.0,
+                               rtol=1e-6)
+    img = np.arange(2 * 3 * 4 * 5, dtype=np.uint8).reshape(2, 3, 4, 5)
+    hwc = native.chw_u8_to_hwc_f32(img, scale=1.0, shift=0.0)
+    np.testing.assert_allclose(
+        hwc, np.transpose(img, (0, 2, 3, 1)).astype(np.float32))
+
+
+def test_record_iterator_native_path_equivalence(tmp_path):
+    """The whole-file native parse must produce identical DataSets to
+    the per-row csv path."""
+    from deeplearning4j_tpu import native
+
+    lines = [f"{i * 0.5},{i * 2},{i % 3}" for i in range(11)]
+    p = tmp_path / "d.csv"
+    p.write_text("\n".join(lines))
+    fast = RecordReaderDataSetIterator(
+        CSVRecordReader(str(p)), batch_size=4, label_index=2,
+        num_classes=3)
+    batches_fast = list(fast)
+    # force the general path by making to_matrix return None
+    slow_reader = CSVRecordReader(str(p))
+    slow_reader.to_matrix = lambda: None
+    slow = RecordReaderDataSetIterator(
+        slow_reader, batch_size=4, label_index=2, num_classes=3)
+    batches_slow = list(slow)
+    assert len(batches_fast) == len(batches_slow) == 3
+    for a, b in zip(batches_fast, batches_slow):
+        np.testing.assert_allclose(a.features, b.features, rtol=1e-6)
+        np.testing.assert_array_equal(a.labels, b.labels)
+    if native.available():
+        assert fast._native_batches is not None   # fast path was used

@@ -135,3 +135,32 @@ def test_ui_server_serves_dashboard(rng):
 def test_render_html_empty_storage_raises():
     with pytest.raises(ValueError, match="no sessions"):
         render_html(InMemoryStatsStorage())
+
+
+def test_remote_stats_router_round_trip(rng):
+    """listener -> RemoteStatsStorageRouter -> HTTP POST -> UIServer
+    receiver -> storage (ref RemoteUIStatsStorageRouter.java:33)."""
+    from deeplearning4j_tpu.stats import RemoteStatsStorageRouter
+
+    receiver_storage = InMemoryStatsStorage()
+    server = UIServer(port=0).attach(receiver_storage).start()
+    try:
+        router = RemoteStatsStorageRouter(
+            f"http://127.0.0.1:{server.port}")
+        listener = StatsListener(router, frequency=5, session_id="rem")
+        net = _lenet_ish()
+        _train(net, listener, rng, iters=10)
+        reports = receiver_storage.reports("rem")
+        assert len(reports) >= 1
+        assert reports[-1].score is not None
+        assert "0/W" in reports[-1].param_mean_magnitudes
+    finally:
+        server.stop()
+
+
+def test_remote_router_is_write_only():
+    from deeplearning4j_tpu.stats import RemoteStatsStorageRouter
+
+    r = RemoteStatsStorageRouter("http://127.0.0.1:1/")
+    with pytest.raises(NotImplementedError):
+        r.session_ids()
