@@ -10,3 +10,7 @@ from deeplearning4j_tpu.clustering.kmeans import (  # noqa: F401
     KMeansClustering,
 )
 from deeplearning4j_tpu.clustering.tsne import Tsne  # noqa: F401
+from deeplearning4j_tpu.clustering.server import (  # noqa: F401
+    NearestNeighborsClient,
+    NearestNeighborsServer,
+)

@@ -1,0 +1,136 @@
+"""Nearest-neighbor REST microservice + client.
+
+Parity: deeplearning4j-nearestneighbor-server / -client / -model —
+a small HTTP service answering k-NN queries over an indexed corpus
+(ref NearestNeighborsServer.java; JSON request/response records in
+deeplearning4j-nearestneighbor-model).
+
+TPU-native difference: batch queries hit the device knn path
+(clustering.distances — MXU distance matrix + top_k); single exact
+queries can use the host VPTree. stdlib http.server, same pattern as
+stats.dashboard.UIServer."""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.clustering.distances import knn
+
+
+class NearestNeighborsServer:
+    """POST /knn {"points": [[...], ...], "k": 5} ->
+    {"results": [{"indices": [...], "distances": [...]}, ...]}
+    GET /status -> {"num_points": N, "dims": D}"""
+
+    def __init__(self, corpus, port: int = 0, host: str = "127.0.0.1",
+                 metric: str = "euclidean"):
+        self.corpus = np.asarray(corpus, np.float32)
+        if self.corpus.ndim != 2:
+            raise ValueError("corpus must be [N, D]")
+        self.metric = metric
+        self.host = host
+        self.port = port
+        self._httpd = None
+        self._thread = None
+
+    def start(self) -> "NearestNeighborsServer":
+        import http.server
+        import socketserver
+
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def _send(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path.rstrip("/") == "/status":
+                    self._send(200, {
+                        "num_points": int(server.corpus.shape[0]),
+                        "dims": int(server.corpus.shape[1]),
+                        "metric": server.metric})
+                else:
+                    self._send(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                try:
+                    if self.path.rstrip("/") != "/knn":
+                        raise ValueError(f"no route {self.path}")
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n).decode())
+                    pts = np.asarray(req["points"], np.float32)
+                    if pts.ndim == 1:
+                        pts = pts[None, :]
+                    k = int(req.get("k", 1))
+                    idx, dist = knn(pts, server.corpus, k=k,
+                                    metric=server.metric)
+                    self._send(200, {"results": [
+                        {"indices": [int(i) for i in row_i],
+                         "distances": [float(d) for d in row_d]}
+                        for row_i, row_d in zip(idx, dist)]})
+                except Exception as e:   # noqa: BLE001 - HTTP boundary
+                    self._send(400, {"error": str(e)})
+
+            def log_message(self, *a):
+                pass
+
+        class _Server(socketserver.ThreadingMixIn, http.server.HTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._httpd = _Server((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+class NearestNeighborsClient:
+    """ref NearestNeighborsClient.java."""
+
+    def __init__(self, url: str, timeout: float = 10.0):
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+
+    def _post(self, route: str, payload: dict) -> dict:
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.url + route, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return json.loads(r.read().decode())
+
+    def knn(self, point, k: int = 1):
+        """Single query -> (indices, distances)."""
+        res = self._post("/knn", {"points": [list(map(float, point))],
+                                  "k": k})["results"][0]
+        return res["indices"], res["distances"]
+
+    def knn_batch(self, points, k: int = 1):
+        res = self._post("/knn", {
+            "points": [list(map(float, p)) for p in points], "k": k})
+        return res["results"]
+
+    def status(self) -> dict:
+        import urllib.request
+
+        with urllib.request.urlopen(self.url + "/status",
+                                    timeout=self.timeout) as r:
+            return json.loads(r.read().decode())

@@ -126,3 +126,28 @@ def test_tsne_separates_clusters(rng):
 def test_tsne_perplexity_guard():
     with pytest.raises(ValueError, match="perplexity"):
         Tsne(perplexity=30.0).fit_transform(np.zeros((10, 3), np.float32))
+
+
+def test_nearest_neighbors_server_client(rng):
+    """REST k-NN microservice round trip (ref nearestneighbor-server/
+    -client modules)."""
+    from deeplearning4j_tpu.clustering import (
+        NearestNeighborsClient,
+        NearestNeighborsServer,
+    )
+
+    corpus = rng.normal(size=(150, 6)).astype(np.float32)
+    server = NearestNeighborsServer(corpus, port=0).start()
+    try:
+        client = NearestNeighborsClient(
+            f"http://127.0.0.1:{server.port}")
+        st = client.status()
+        assert st["num_points"] == 150 and st["dims"] == 6
+        q = rng.normal(size=(6,))
+        idx, dist = client.knn(q, k=5)
+        brute = np.linalg.norm(corpus - q.astype(np.float32), axis=1)
+        np.testing.assert_array_equal(idx, np.argsort(brute)[:5])
+        batch = client.knn_batch(rng.normal(size=(3, 6)), k=2)
+        assert len(batch) == 3 and len(batch[0]["indices"]) == 2
+    finally:
+        server.stop()
