@@ -76,10 +76,58 @@ def bench_resnet50(batch=128, hw=224, iters=30, compute_dtype="bfloat16"):
     return batch * iters / dt, dt / iters, final_loss
 
 
+def bench_lstm(batch=64, seq_len=256, vocab=98, iters=30):
+    """BASELINE config #3: GravesLSTM char-RNN tokens/sec
+    (ref zoo/model/TextGenerationLSTM.java; LSTMHelpers.java:182,448).
+    Run with `python bench.py lstm`."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.zoo import TextGenerationLSTM
+
+    net = TextGenerationLSTM(num_classes=vocab,
+                             input_shape=(seq_len, vocab),
+                             compute_dtype="bfloat16").init_model()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, vocab, (batch, seq_len))
+    x = jax.device_put(jnp.asarray(
+        np.eye(vocab, dtype=np.float32)[ids]))
+    y = jax.device_put(jnp.asarray(
+        np.eye(vocab, dtype=np.float32)[np.roll(ids, -1, 1)]))
+    _ = float(jnp.sum(x[0, 0]))
+
+    loss, _ = net._train_step(x, y)
+    _ = float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss, _ = net._train_step(x, y)
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss)
+    return batch * seq_len * iters / dt, dt / iters, final_loss
+
+
 def main():
+    import sys
+
     import jax
 
     dev = jax.devices()[0]
+    if len(sys.argv) > 1 and sys.argv[1] == "lstm":
+        tps, step_s, loss = bench_lstm()
+        print(json.dumps({
+            "metric": "lstm_char_rnn_tokens_per_sec_per_chip",
+            "value": round(tps, 1),
+            "unit": "tokens/sec/chip",
+            "vs_baseline": 1.0,
+            "step_time_ms": round(step_s * 1e3, 1),
+            "final_loss": round(loss, 3),
+            "config": "batch=64 seq=256 vocab=98 2xLSTM(256)",
+            "device": str(dev.device_kind),
+            "platform": str(dev.platform),
+            "jax": jax.__version__,
+        }))
+        return
     ips, step_s, loss = bench_resnet50()
     key = "resnet50_train_images_per_sec_per_chip"
     base = BASELINES.get(key)
