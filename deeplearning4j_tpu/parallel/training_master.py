@@ -145,6 +145,56 @@ class TrainingMaster:
                     self.save_checkpoint(done)
         return self
 
+    # ------------------------------------------------------------ evaluate
+    def evaluate(self, batch_fn: Callable[[int], Tuple], num_steps: int,
+                 evaluation=None):
+        """Distributed evaluation (the Spark eval flatMap+reduce role,
+        IEvaluateFlatMapFunction/IEvaluationReduceFunction): every
+        process runs inference on its partition of each batch; the
+        device argmax comparison is summed over the dp axis inside the
+        compiled program, so each host ends with identical GLOBAL
+        confusion counts folded into `evaluation`."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from deeplearning4j_tpu.eval import Evaluation
+
+        self._stage_net()
+        net = self.net
+        if evaluation is None:
+            evaluation = Evaluation()
+        is_graph = hasattr(net.conf, "network_inputs")
+        rep = NamedSharding(self.mesh, P())
+
+        @jax.jit
+        def confusion_counts(params, states, x, y):
+            if is_graph:
+                name = net.conf.network_inputs[0]
+                acts, _, _ = net._forward(params, states, {name: x},
+                                          train=False, rng=None)
+                out = acts[net.conf.network_outputs[0]]
+            else:
+                out, _, _ = net._forward(params, states, x,
+                                         train=False, rng=None)
+            pred = jnp.argmax(out, axis=-1)
+            actual = jnp.argmax(y, axis=-1)
+            c = y.shape[-1]
+            onehot = (jax.nn.one_hot(actual, c)[:, :, None]
+                      * jax.nn.one_hot(pred, c)[:, None, :])
+            # global sum: GSPMD reduces over the dp-sharded batch
+            return jax.lax.with_sharding_constraint(
+                jnp.sum(onehot, axis=0), rep)
+
+        with self.mesh:
+            for step in range(num_steps):
+                x, y = self._global_batch(*batch_fn(step))
+                counts = confusion_counts(net.params, net.states, x, y)
+                m = np.asarray(self._host_leaf(counts)).astype(np.int64)
+                evaluation._ensure(m.shape[0])
+                evaluation.confusion.matrix += m
+        return evaluation
+
     # ------------------------------------------------------- checkpointing
     def _ckpt_path(self, step: int) -> str:
         return os.path.join(self.checkpoint_dir, f"step-{step:08d}.npz")

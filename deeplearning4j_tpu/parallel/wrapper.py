@@ -73,11 +73,11 @@ class ParallelWrapper:
             return
         ins = getattr(self.net.conf, "network_inputs", None)
         outs = getattr(self.net.conf, "network_outputs", None)
-        if ins is not None and (len(ins) > 1 or len(outs) > 1):
+        self._multi_io = ins is not None and (len(ins) > 1 or len(outs) > 1)
+        if self._multi_io and self.averaging_frequency > 1:
             raise NotImplementedError(
-                "ParallelWrapper currently supports single-input/single-"
-                "output graphs; shard multi-input batches manually via "
-                "parallel.sharding.shard_batch + the graph's _train_step")
+                "averaging_frequency > 1 supports single-input/single-"
+                "output graphs only")
         if self.net.params is None:
             self.net.init()
         put = lambda tree: jax.tree_util.tree_map(
@@ -151,6 +151,11 @@ class ParallelWrapper:
                     batches.reset()
                 group = []
                 for batch in batches:
+                    if getattr(self, "_multi_io", False):
+                        self._fit_multi_io(batch)
+                        for listener in net.listeners:
+                            listener.iteration_done(net, net.iteration)
+                        continue
                     x, y, fm, lm = self._pad_with_masks(*_as_batch(batch))
                     if k > 1:
                         group.append((x, y, fm, lm))
@@ -181,6 +186,32 @@ class ParallelWrapper:
                     self._local_step.run(group)
                 net.epoch += 1
         return self
+
+    def _fit_multi_io(self, batch):
+        """Multi-input/multi-output graph batch: shard every input,
+        label, and mask over dp (batch must be dp-divisible — ragged
+        padding is only automated on the single-io path)."""
+        from deeplearning4j_tpu.nn.graph import _as_multi
+
+        net = self.net
+        ins, labs, fms, lms = _as_multi(batch)
+        b = np.asarray(ins[0]).shape[0]
+        if b % self.dp:
+            raise ValueError(
+                f"multi-input batch size {b} must be divisible by "
+                f"dp={self.dp} (pad the batch or mask rows yourself)")
+        names = net.conf.network_inputs
+        sb = lambda a: shard_batch(self.mesh, jnp.asarray(a, net.dtype))
+        inputs = {n: sb(x) for n, x in zip(names, ins)}
+        labels = [sb(y) for y in labs]
+        fmasks = None
+        if fms is not None:
+            fmasks = {n: (None if m is None else sb(m))
+                      for n, m in zip(names, fms)}
+        lmasks = None
+        if lms is not None:
+            lmasks = [None if m is None else sb(m) for m in lms]
+        net._train_step(inputs, labels, fmasks, lmasks)
 
     def output(self, x):
         self._ensure_sharded()

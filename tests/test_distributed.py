@@ -137,3 +137,40 @@ def test_single_process_training_master(tmp_path, rng):
           __import__("jax").tree_util.tree_leaves(net3.params)]
     for a, b in zip(p2, p3):
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_training_master_distributed_evaluate(rng):
+    """Global confusion counts via in-program dp reduction match a
+    host-side evaluation of the same data."""
+    sys.path.insert(0, os.path.join(os.path.dirname(HELPER)))
+    import distributed_worker as dw
+
+    from deeplearning4j_tpu.eval import Evaluation
+    from deeplearning4j_tpu.parallel.training_master import TrainingMaster
+
+    net = dw.build_net()
+    tm = TrainingMaster(net)
+    tm.fit(lambda s: dw.global_batch(s), 3)
+    ev = tm.evaluate(lambda s: dw.global_batch(100 + s), 2)
+
+    expect = Evaluation()
+    for s in range(2):
+        x, y = dw.global_batch(100 + s)
+        expect.eval(y, np.asarray(net.output(x)))
+    np.testing.assert_array_equal(ev.confusion.matrix,
+                                  expect.confusion.matrix)
+    assert 0.0 <= ev.accuracy() <= 1.0
+
+
+def test_evaluation_merge():
+    from deeplearning4j_tpu.eval import Evaluation
+
+    a = Evaluation(3)
+    b = Evaluation(3)
+    y = np.eye(3, dtype=np.float32)
+    a.eval(y, y)                      # 3 correct
+    p = np.roll(y, 1, axis=1)
+    b.eval(y, p)                      # 3 wrong
+    a.merge(b)
+    assert a.confusion.total() == 6
+    assert a.accuracy() == 0.5
