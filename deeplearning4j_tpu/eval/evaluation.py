@@ -85,18 +85,6 @@ class Evaluation:
             self.top_n_total += len(actual)
 
     # ---- metrics ----
-    def merge(self, other: "Evaluation") -> "Evaluation":
-        """Merge another evaluation's counts into this one (the
-        distributed-eval reduction, ref IEvaluationReduceFunction /
-        IEvaluation.merge in the Spark eval path)."""
-        if other.confusion is None:
-            return self
-        self._ensure(other.confusion.num_classes)
-        self.confusion.matrix += other.confusion.matrix
-        self.top_n_total += other.top_n_total
-        self.top_n_correct += other.top_n_correct
-        return self
-
     def accuracy(self) -> float:
         m = self.confusion.matrix
         total = m.sum()

@@ -114,7 +114,8 @@ class TrainingMaster:
 
     # ----------------------------------------------------------------- fit
     def fit(self, batch_fn: Callable[[int], Tuple], num_steps: int,
-            start_step: Optional[int] = None):
+            start_step: Optional[int] = None,
+            collect_training_stats: bool = False):
         """Train for `num_steps` global steps.
 
         `batch_fn(step) -> (x_local, y_local)`: THIS process's partition
@@ -123,27 +124,88 @@ class TrainingMaster:
         step index is the iterator position).
 
         If `start_step` is None and a checkpoint exists, training
-        resumes after the last checkpointed step."""
+        resumes after the last checkpointed step.
+
+        `collect_training_stats=True` records per-step phase timings
+        (data staging / train step / checkpoint) retrievable via
+        `training_stats()` — the Spark CommonSparkTrainingStats role
+        (ref TrainingMaster.setCollectTrainingStats,
+        spark/stats/StatsUtils.java timeline export)."""
+        import time
+
         self._stage_net()
         net = self.net
         if start_step is None:
             start_step = self.load_latest_checkpoint()
+        if collect_training_stats:
+            self._stats = []
         is_graph = hasattr(net.conf, "network_inputs")
         with self.mesh:
             for step in range(start_step, num_steps):
+                t0 = time.perf_counter()
                 x, y = self._global_batch(*batch_fn(step))
+                t1 = time.perf_counter()
                 if is_graph:
                     name = net.conf.network_inputs[0]
                     net._train_step({name: x}, [y])
                 else:
                     net._train_step(x, y)
+                if collect_training_stats:
+                    # host fetch = true step barrier for honest timing
+                    float(net.score())
+                t2 = time.perf_counter()
                 for listener in net.listeners:
                     listener.iteration_done(net, net.iteration)
+                t3 = time.perf_counter()
                 done = step + 1
                 if (self.checkpoint_dir and self.checkpoint_every
                         and done % self.checkpoint_every == 0):
                     self.save_checkpoint(done)
+                if collect_training_stats:
+                    self._stats.append({
+                        "step": step,
+                        "data_ms": (t1 - t0) * 1e3,
+                        "fit_ms": (t2 - t1) * 1e3,
+                        "listener_ms": (t3 - t2) * 1e3,
+                        "checkpoint_ms":
+                            (time.perf_counter() - t3) * 1e3,
+                    })
         return self
+
+    def training_stats(self):
+        """Per-step phase timings recorded when fit(...,
+        collect_training_stats=True) — the CommonSparkTrainingStats
+        equivalent. Returns a list of dicts plus an aggregate row."""
+        stats = list(getattr(self, "_stats", []))
+        if not stats:
+            return {"steps": [], "summary": {}}
+        summary = {
+            k: float(np.mean([s[k] for s in stats]))
+            for k in ("data_ms", "fit_ms", "listener_ms", "checkpoint_ms")
+        }
+        return {"steps": stats, "summary": summary}
+
+    def export_stats_html(self, path: str):
+        """Timeline HTML export (ref StatsUtils.exportStatsAsHtml)."""
+        import json as _json
+
+        data = self.training_stats()
+        rows = "".join(
+            f"<tr><td>{s['step']}</td><td>{s['data_ms']:.2f}</td>"
+            f"<td>{s['fit_ms']:.2f}</td>"
+            f"<td>{s['checkpoint_ms']:.2f}</td></tr>"
+            for s in data["steps"])
+        page = (
+            "<!DOCTYPE html><html><head><meta charset='utf-8'>"
+            "<title>training timeline</title></head><body>"
+            f"<h1>TrainingMaster timeline</h1>"
+            f"<p>summary: {_json.dumps(data['summary'])}</p>"
+            "<table border='1'><tr><th>step</th><th>data ms</th>"
+            "<th>fit ms</th><th>checkpoint ms</th></tr>"
+            f"{rows}</table></body></html>")
+        with open(path, "w") as f:
+            f.write(page)
+        return path
 
     # ------------------------------------------------------------ evaluate
     def evaluate(self, batch_fn: Callable[[int], Tuple], num_steps: int,
@@ -167,24 +229,30 @@ class TrainingMaster:
         is_graph = hasattr(net.conf, "network_inputs")
         rep = NamedSharding(self.mesh, P())
 
-        @jax.jit
-        def confusion_counts(params, states, x, y):
-            if is_graph:
-                name = net.conf.network_inputs[0]
-                acts, _, _ = net._forward(params, states, {name: x},
-                                          train=False, rng=None)
-                out = acts[net.conf.network_outputs[0]]
-            else:
-                out, _, _ = net._forward(params, states, x,
-                                         train=False, rng=None)
-            pred = jnp.argmax(out, axis=-1)
-            actual = jnp.argmax(y, axis=-1)
-            c = y.shape[-1]
-            onehot = (jax.nn.one_hot(actual, c)[:, :, None]
-                      * jax.nn.one_hot(pred, c)[:, None, :])
-            # global sum: GSPMD reduces over the dp-sharded batch
-            return jax.lax.with_sharding_constraint(
-                jnp.sum(onehot, axis=0), rep)
+        if getattr(self, "_eval_fn", None) is None:
+            @jax.jit
+            def confusion_counts(params, states, x, y):
+                if is_graph:
+                    name = net.conf.network_inputs[0]
+                    acts, _, _ = net._forward(params, states, {name: x},
+                                              train=False, rng=None)
+                    out = acts[net.conf.network_outputs[0]]
+                else:
+                    out, _, _ = net._forward(params, states, x,
+                                             train=False, rng=None)
+                c = y.shape[-1]
+                # time-series outputs [N,T,C] flatten to rows like
+                # Evaluation.eval does
+                pred = jnp.argmax(out, axis=-1).reshape(-1)
+                actual = jnp.argmax(y, axis=-1).reshape(-1)
+                onehot = (jax.nn.one_hot(actual, c)[:, :, None]
+                          * jax.nn.one_hot(pred, c)[:, None, :])
+                # global sum: GSPMD reduces over the dp-sharded batch
+                return jax.lax.with_sharding_constraint(
+                    jnp.sum(onehot, axis=0), rep)
+
+            self._eval_fn = confusion_counts
+        confusion_counts = self._eval_fn
 
         with self.mesh:
             for step in range(num_steps):

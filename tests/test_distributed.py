@@ -174,3 +174,21 @@ def test_evaluation_merge():
     a.merge(b)
     assert a.confusion.total() == 6
     assert a.accuracy() == 0.5
+
+
+def test_training_stats_collection(tmp_path):
+    sys.path.insert(0, os.path.join(os.path.dirname(HELPER)))
+    import distributed_worker as dw
+
+    from deeplearning4j_tpu.parallel.training_master import TrainingMaster
+
+    net = dw.build_net()
+    tm = TrainingMaster(net)
+    tm.fit(lambda s: dw.global_batch(s), 3, collect_training_stats=True)
+    stats = tm.training_stats()
+    assert len(stats["steps"]) == 3
+    assert stats["summary"]["fit_ms"] > 0
+    out = str(tmp_path / "timeline.html")
+    tm.export_stats_html(out)
+    content = open(out).read()
+    assert "TrainingMaster timeline" in content and "<table" in content
