@@ -266,7 +266,8 @@ class SequenceVectors:
                  min_word_frequency: int = 1, learning_rate: float = 0.025,
                  min_learning_rate: float = 1e-4, epochs: int = 1,
                  batch_size: int = 512, sampling: float = 0.0,
-                 use_cbow: bool = False, seed: int = 42):
+                 use_cbow: bool = False, seed: int = 42,
+                 chunk: Optional[int] = None):
         self.layer_size = layer_size
         self.window = window
         self.negative = negative
@@ -285,12 +286,36 @@ class SequenceVectors:
         self.syn1neg: Optional[np.ndarray] = None
         self._unigram: Optional[np.ndarray] = None
         self._max_code_len = 0
-        # one chunk constant shared by both jit steps; batch_size is
+        # One chunk constant shared by all jit steps; batch_size is
         # rounded up to a chunk multiple so full batches never need
         # padding (padding replicates pairs -> over-trains them) and
         # _chunk_of never degrades for prime batch sizes.
-        self._chunk = 32
-        self.batch_size = -(-batch_size // self._chunk) * self._chunk
+        # The chunk trades fidelity to the reference's one-pair-at-a-time
+        # SGD against device efficiency (each chunk is one scan
+        # iteration): tiny vocabularies need small chunks or in-batch
+        # duplicate updates collapse embeddings; large vocabularies
+        # almost never repeat a word within a chunk, so big chunks are
+        # safe and ~10-30x faster. chunk=None (default) resolves at
+        # fit() time from the vocab size.
+        self._chunk_param = chunk
+        self._raw_batch_size = batch_size
+        self._chunk = None
+        self.batch_size = batch_size
+        self._neg_step = None
+        self._hs_step = None
+        self._cbow_neg_step = None
+        self._cbow_hs_step = None
+
+    def _ensure_steps(self):
+        if self._neg_step is not None:
+            return
+        if self._chunk_param is not None:
+            self._chunk = int(self._chunk_param)
+        else:
+            V = self.vocab.num_words()
+            self._chunk = 32 if V < 2048 else 512
+        self.batch_size = (-(-self._raw_batch_size // self._chunk)
+                           * self._chunk)
         self._neg_step = _NegSamplingStep(chunk=self._chunk)
         self._hs_step = _HierarchicSoftmaxStep(chunk=self._chunk)
         self._cbow_neg_step = _CbowNegSamplingStep(chunk=self._chunk)
@@ -314,7 +339,15 @@ class SequenceVectors:
             self.syn1neg = np.zeros((V, self.layer_size), np.float32)
             counts = self.vocab.counts() ** 0.75
             self._unigram = (counts / counts.sum()).astype(np.float64)
+            # inverse-CDF sampling (searchsorted) is O(log V) per draw vs
+            # rng.choice(p=...)'s per-call setup — the negative-sampling
+            # hot path
+            self._unigram_cdf = np.cumsum(self._unigram)
         return self
+
+    def _draw_negatives(self, rng, shape):
+        u = rng.random(shape)
+        return np.searchsorted(self._unigram_cdf, u).astype(np.int64)
 
     # ----------------------------------------------------------- pairs
     def _sequence_indices(self, seq, rng):
@@ -366,6 +399,7 @@ class SequenceVectors:
         seqs = [list(s) for s in sequences]
         if self.syn0 is None:
             self.build_vocab(seqs)
+        self._ensure_steps()
         import jax.numpy as jnp
 
         rng = np.random.default_rng(self.seed + 1)
@@ -447,15 +481,14 @@ class SequenceVectors:
         would label the same index 1 and 0 in one update."""
         B = self.batch_size
         K = self.negative
-        V = self.vocab.num_words()
         pos = np.asarray(positives, np.int64)[:, None]
-        neg = rng.choice(V, size=(B, K), p=self._unigram)
+        neg = self._draw_negatives(rng, (B, K))
         for _ in range(16):
             coll = neg == pos
             n_coll = int(coll.sum())
             if not n_coll:
                 break
-            neg[coll] = rng.choice(V, size=n_coll, p=self._unigram)
+            neg[coll] = self._draw_negatives(rng, n_coll)
         targets = np.concatenate([pos, neg], axis=1)
         labels = np.zeros((B, K + 1), np.float32)
         labels[:, 0] = 1.0

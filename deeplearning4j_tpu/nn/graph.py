@@ -442,6 +442,44 @@ class ComputationGraph:
                                    train=train, rng=None)
         return acts
 
+    def evaluate(self, iterator, evaluation=None, output_index: int = 0):
+        """Evaluate the output at `output_index` over a (Multi)DataSet
+        iterator (ref: ComputationGraph.evaluate(DataSetIterator))."""
+        from deeplearning4j_tpu.eval import Evaluation
+
+        ev = evaluation if evaluation is not None else Evaluation()
+        for batch in iterator:
+            ins, labs, fms, lms = _as_multi(batch)
+            out = self.output(*ins)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            lm = None if lms is None else lms[output_index]
+            ev.eval(np.asarray(labs[output_index]),
+                    np.asarray(outs[output_index]), mask=lm)
+        return ev
+
+    def summary(self) -> str:
+        """Node table with shapes and parameter counts
+        (ref: ComputationGraph.summary())."""
+        rows = [("name", "kind", "type", "inputs", "out", "params")]
+        total = 0
+        for node in self.topo:
+            if node.kind == "layer" and self.params is not None:
+                n = sum(int(np.prod(l.shape)) for l in
+                        jax.tree_util.tree_leaves(self.params[node.name]))
+            else:
+                n = 0
+            total += n
+            out_t = (str(self.node_types.get(node.name))
+                     if self.node_types else "?")
+            rows.append((node.name, node.kind, type(node.obj).__name__,
+                         ",".join(node.inputs), out_t, f"{n:,}"))
+        widths = [max(len(r[c]) for r in rows) for c in range(6)]
+        lines = ["  ".join(v.ljust(w) for v, w in zip(r, widths))
+                 for r in rows]
+        lines.insert(1, "-" * len(lines[0]))
+        lines.append(f"Total parameters: {total:,}")
+        return "\n".join(lines)
+
     def score(self, data=None):
         if data is None:
             return None if self._score is None else float(self._score)

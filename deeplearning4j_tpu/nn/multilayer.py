@@ -483,6 +483,40 @@ class MultiLayerNetwork:
                                 fm, lm, train=False)
         return float(loss)
 
+    def evaluate(self, iterator, evaluation=None):
+        """Evaluate over a DataSet iterator (ref: MLN.evaluate(
+        DataSetIterator)). Returns the accumulated Evaluation."""
+        from deeplearning4j_tpu.eval import Evaluation
+
+        ev = evaluation if evaluation is not None else Evaluation()
+        for batch in iterator:
+            x, y, fm, lm = _as_batch(batch)
+            ev.eval(np.asarray(y), np.asarray(self.output(x)), mask=lm)
+        return ev
+
+    def summary(self) -> str:
+        """Layer table with shapes and parameter counts
+        (ref: MultiLayerNetwork.summary())."""
+        rows = [("idx", "layer", "in -> out", "params")]
+        total = 0
+        in_type = self.conf.input_type
+        for i, (layer, t) in enumerate(
+                zip(self.conf.layers, self.layer_input_types or
+                    [None] * len(self.conf.layers))):
+            out_t = layer.output_type(t) if t is not None else "?"
+            n = (sum(int(np.prod(l.shape)) for l in
+                     jax.tree_util.tree_leaves(self.params[i]))
+                 if self.params is not None else 0)
+            total += n
+            rows.append((str(i), type(layer).__name__,
+                         f"{t} -> {out_t}", f"{n:,}"))
+        widths = [max(len(r[c]) for r in rows) for c in range(4)]
+        lines = ["  ".join(v.ljust(w) for v, w in zip(r, widths))
+                 for r in rows]
+        lines.insert(1, "-" * len(lines[0]))
+        lines.append(f"Total parameters: {total:,}")
+        return "\n".join(lines)
+
     # --------------------------------------------------------- streaming RNN
     def rnn_time_step(self, x):
         """Stateful O(1)-per-step decoding (ref: MLN.rnnTimeStep:2526).

@@ -183,3 +183,55 @@ def test_tbptt_training(rng):
     s0 = net.score((x, y))
     net.fit([(x, y)], epochs=10)
     assert net.score((x, y)) < s0
+
+
+def test_summary_and_evaluate(rng):
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    conf = (NeuralNetConfiguration.Builder().seed(1).updater("adam")
+            .learning_rate(5e-2).weight_init("xavier").list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=2, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    s = net.summary()
+    assert "DenseLayer" in s and "OutputLayer" in s
+    assert "Total parameters" in s
+    # 4*8+8 + 8*2+2 = 58
+    assert "58" in s.replace(",", "")
+
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    labels = (x[:, 0] > 0).astype(int)
+    x[:, 1] += labels * 2.0
+    y = np.eye(2, dtype=np.float32)[labels]
+    net.fit([(x, y)] * 40)
+    ev = net.evaluate([(x, y)])
+    assert ev.accuracy() > 0.8
+    assert ev.confusion.total() == 64
+
+
+def test_graph_summary_and_evaluate(rng):
+    from deeplearning4j_tpu import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import InputType
+    from deeplearning4j_tpu.nn.conf.graph_conf import GraphBuilder
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    gb = (GraphBuilder(NeuralNetConfiguration.Builder().seed(2)
+                       .updater("adam").learning_rate(5e-2)
+                       .weight_init("xavier"))
+          .add_inputs("x")
+          .add_layer("h", DenseLayer(n_out=8, activation="tanh"), "x")
+          .add_layer("o", OutputLayer(n_out=2, loss="mcxent"), "h")
+          .set_outputs("o")
+          .set_input_types(x=InputType.feed_forward(4)))
+    net = ComputationGraph(gb.build()).init()
+    s = net.summary()
+    assert "h" in s and "DenseLayer" in s and "Total parameters" in s
+    x = rng.normal(size=(32, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+    net.fit([([x], [y])] * 30)
+    ev = net.evaluate([([x], [y])])
+    assert ev.confusion.total() == 32
