@@ -154,3 +154,37 @@ def test_vae_supervised(rng):
                                    decoder_layer_sizes=(8,)),
             OutputLayer(n_out=2, loss="mcxent")],
            InputType.feed_forward(6), x, y, subset=30)
+
+
+def test_all_loss_functions(rng):
+    """Gradient-check every registered loss with domain-appropriate
+    labels/activations (ref: LossFunctionGradientCheck.java sweeping the
+    full ILossFunction set)."""
+    cases = {
+        "mse": ("identity", lambda: rng.normal(size=(4, 2))),
+        "l2": ("identity", lambda: rng.normal(size=(4, 2))),
+        "mae": ("identity", lambda: rng.normal(size=(4, 2)) + 3.0),
+        "mape": ("identity", lambda: rng.uniform(1.0, 2.0, (4, 2))),
+        "msle": ("softplus", lambda: rng.uniform(0.5, 2.0, (4, 2))),
+        "mcxent": ("softmax",
+                   lambda: np.eye(2)[rng.integers(0, 2, 4)]),
+        "negativeloglikelihood": (
+            "softmax", lambda: np.eye(2)[rng.integers(0, 2, 4)]),
+        "xent": ("sigmoid", lambda: rng.uniform(0.05, 0.95, (4, 2))),
+        "hinge": ("identity",
+                  lambda: rng.choice([-1.0, 1.0], (4, 2))),
+        "squared_hinge": ("identity",
+                          lambda: rng.choice([-1.0, 1.0], (4, 2))),
+        "poisson": ("softplus",
+                    lambda: rng.integers(0, 5, (4, 2)).astype(float)),
+        "kl_divergence": ("softmax", lambda: (
+            lambda p: p / p.sum(1, keepdims=True))(
+                rng.uniform(0.1, 1.0, (4, 2)))),
+        "cosine_proximity": ("identity", lambda: rng.normal(size=(4, 2))),
+    }
+    for loss, (act, make_y) in cases.items():
+        x = rng.normal(size=(4, 3))
+        y = np.asarray(make_y(), np.float64)
+        _check([DenseLayer(n_out=5),
+                OutputLayer(n_out=2, loss=loss, activation=act)],
+               InputType.feed_forward(3), x, y)

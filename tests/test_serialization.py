@@ -113,3 +113,84 @@ def test_restore_rejects_shape_mismatch(rng, tmp_path):
         z.writestr("coefficients.npz", coeff)
     with pytest.raises(ValueError):
         ModelSerializer.restore_multi_layer_network(bad)
+
+
+def test_all_layer_types_json_round_trip():
+    """Every concrete layer class survives conf JSON round-trip
+    (the polymorphic-serde contract behind the regression tests)."""
+    from deeplearning4j_tpu import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import InputType
+    from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+    from deeplearning4j_tpu.nn.layers import (
+        LSTM,
+        ActivationLayer,
+        AutoEncoder,
+        BatchNormalization,
+        CenterLossOutputLayer,
+        Convolution1DLayer,
+        ConvolutionLayer,
+        DenseLayer,
+        DropoutLayer,
+        EmbeddingLayer,
+        GlobalPoolingLayer,
+        GravesBidirectionalLSTM,
+        GravesLSTM,
+        LocalResponseNormalization,
+        LossLayer,
+        OutputLayer,
+        RnnOutputLayer,
+        Subsampling1DLayer,
+        SubsamplingLayer,
+        VariationalAutoencoder,
+        ZeroPaddingLayer,
+    )
+
+    stacks = [
+        (InputType.convolutional(12, 12, 2), [
+            ZeroPaddingLayer(padding=(1, 1)),
+            ConvolutionLayer(kernel_size=(3, 3), n_out=4,
+                             activation="relu"),
+            BatchNormalization(),
+            LocalResponseNormalization(),
+            SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)),
+            ActivationLayer(activation="tanh"),
+            DropoutLayer(dropout=0.3),
+            DenseLayer(n_out=8),
+            OutputLayer(n_out=3, loss="mcxent"),
+        ]),
+        (InputType.recurrent(5, 7), [
+            LSTM(n_out=6),
+            GravesLSTM(n_out=6),
+            GravesBidirectionalLSTM(n_out=4),
+            RnnOutputLayer(n_out=2, loss="mcxent"),
+        ]),
+        (InputType.recurrent(5, 9), [
+            Convolution1DLayer(kernel_size=3, n_out=4),
+            Subsampling1DLayer(kernel_size=2, stride=2),
+            GlobalPoolingLayer(pooling_type="avg"),
+            OutputLayer(n_out=2, loss="mcxent"),
+        ]),
+        (InputType.feed_forward(6), [
+            EmbeddingLayer(n_in=10, n_out=4),
+            AutoEncoder(n_out=5),
+            VariationalAutoencoder(n_out=4, encoder_layer_sizes=(8,),
+                                   decoder_layer_sizes=(8,)),
+            DenseLayer(n_out=6),
+            CenterLossOutputLayer(n_out=3, loss="mcxent"),
+        ]),
+        (InputType.feed_forward(4), [
+            DenseLayer(n_out=4, activation="relu"),
+            LossLayer(loss="mse", activation="identity"),
+        ]),
+    ]
+    for in_type, layers in stacks:
+        b = (NeuralNetConfiguration.Builder().seed(7).updater("adam")
+             .weight_init("xavier").list())
+        for l in layers:
+            b = b.layer(l)
+        conf = b.set_input_type(in_type).build()
+        js = conf.to_json()
+        rt = MultiLayerConfiguration.from_json(js)
+        assert rt.to_json() == js
+        assert [type(l).__name__ for l in rt.layers] == \
+            [type(l).__name__ for l in layers]
