@@ -169,13 +169,30 @@ class ParallelWrapper:
                            else shard_batch(self.mesh, jnp.asarray(fm)))
                     lmb = (None if lm is None
                            else shard_batch(self.mesh, jnp.asarray(lm)))
+                    if getattr(net.conf, "optimization_algo",
+                               "stochastic_gradient_descent") not in (
+                            "stochastic_gradient_descent", "sgd"):
+                        raise NotImplementedError(
+                            "line-search solvers are not supported under "
+                            "ParallelWrapper; use the default "
+                            "stochastic_gradient_descent")
+                    is_tbptt = (getattr(net.conf, "backprop_type", None)
+                                == "truncated_bptt"
+                                and getattr(xb, "ndim", 0) == 3)
                     if hasattr(net.conf, "network_inputs"):
                         # ComputationGraph: dict inputs / list labels
                         name = net.conf.network_inputs[0]
-                        net._train_step(
-                            {name: xb}, [yb],
-                            None if fmb is None else {name: fmb},
-                            None if lmb is None else [lmb])
+                        ins = {name: xb}
+                        fms_in = None if fmb is None else {name: fmb}
+                        lms_in = None if lmb is None else [lmb]
+                        if is_tbptt:
+                            net._fit_tbptt(ins, [yb], fms_in, lms_in)
+                        else:
+                            net._train_step(ins, [yb], fms_in, lms_in)
+                    elif is_tbptt:
+                        # time-chunked steps with carried RNN state; the
+                        # sharded batch dim flows through the chunk slices
+                        net._fit_tbptt(xb, yb, fmb, lmb)
                     else:
                         net._train_step(xb, yb, fmb, lmb)
                     for listener in net.listeners:

