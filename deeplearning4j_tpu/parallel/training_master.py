@@ -139,15 +139,29 @@ class TrainingMaster:
             start_step = self.load_latest_checkpoint()
         if collect_training_stats:
             self._stats = []
+        if getattr(net.conf, "optimization_algo",
+                   "stochastic_gradient_descent") not in (
+                "stochastic_gradient_descent", "sgd"):
+            raise NotImplementedError(
+                "line-search solvers are not supported under "
+                "TrainingMaster; use stochastic_gradient_descent")
         is_graph = hasattr(net.conf, "network_inputs")
+        is_tbptt = getattr(net.conf, "backprop_type", None) \
+            == "truncated_bptt"
         with self.mesh:
             for step in range(start_step, num_steps):
                 t0 = time.perf_counter()
                 x, y = self._global_batch(*batch_fn(step))
                 t1 = time.perf_counter()
+                chunked = is_tbptt and getattr(x, "ndim", 0) == 3
                 if is_graph:
                     name = net.conf.network_inputs[0]
-                    net._train_step({name: x}, [y])
+                    if chunked:
+                        net._fit_tbptt({name: x}, [y], None, None)
+                    else:
+                        net._train_step({name: x}, [y])
+                elif chunked:
+                    net._fit_tbptt(x, y, None, None)
                 else:
                     net._train_step(x, y)
                 if collect_training_stats:

@@ -263,6 +263,11 @@ class LocalStepTrainer:
             raise NotImplementedError(
                 "averaging_frequency > 1 requires tp == 1 (local-SGD "
                 "shards carry full param replicas)")
+        if getattr(net.conf, "backprop_type", None) == "truncated_bptt":
+            raise NotImplementedError(
+                "averaging_frequency > 1 does not support truncated "
+                "BPTT (the local-step scan carries no RNN state); use "
+                "averaging_frequency=1")
         self.net = net
         self.mesh = mesh
         self.average_updaters = average_updaters
