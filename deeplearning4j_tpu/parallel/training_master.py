@@ -44,7 +44,16 @@ class TrainingMaster:
     identical seeds the construction IS the broadcast)."""
 
     def __init__(self, net, checkpoint_dir: Optional[str] = None,
-                 checkpoint_every: int = 0, mesh=None):
+                 checkpoint_every: int = 0, mesh=None,
+                 averaging_frequency: int = 1):
+        """`averaging_frequency=k > 1` runs k-step local SGD between
+        parameter rendezvous — each dp shard trains privately for k
+        steps, then params (+ updater state) are averaged. This is the
+        DCN-traffic-reduction role of the reference's threshold-encoded
+        gradient compression (EncodingHandler.java:64): instead of
+        compressing a per-step exchange, the exchange happens k times
+        less often (and sparsification adds nothing on top — the
+        rendezvous is a dense average by construction)."""
         import jax
         from deeplearning4j_tpu.parallel.mesh import make_mesh
 
@@ -54,7 +63,9 @@ class TrainingMaster:
         if mesh is None:
             mesh = make_mesh(dp=len(jax.devices()))
         self.mesh = mesh
+        self.averaging_frequency = max(1, averaging_frequency)
         self._staged = False
+        self._local_step = None
 
     # ------------------------------------------------------------ dist init
     @staticmethod
@@ -148,6 +159,8 @@ class TrainingMaster:
         is_graph = hasattr(net.conf, "network_inputs")
         is_tbptt = getattr(net.conf, "backprop_type", None) \
             == "truncated_bptt"
+        if self.averaging_frequency > 1:
+            return self._fit_local_sgd(batch_fn, num_steps, start_step)
         with self.mesh:
             for step in range(start_step, num_steps):
                 t0 = time.perf_counter()
@@ -184,6 +197,41 @@ class TrainingMaster:
                         "checkpoint_ms":
                             (time.perf_counter() - t3) * 1e3,
                     })
+        return self
+
+    def _fit_local_sgd(self, batch_fn, num_steps, start_step):
+        """k-step local-SGD groups over the global mesh (the DCN
+        compression role — see __init__). Reuses LocalStepTrainer's
+        shard_map program; data stacked [k, G, ...] per group."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from deeplearning4j_tpu.parallel.wrapper import LocalStepTrainer
+
+        net = self.net
+        k = self.averaging_frequency
+        if self._local_step is None:
+            self._local_step = LocalStepTrainer(net, self.mesh)
+        sh = NamedSharding(self.mesh, P(None, "dp"))
+        to_g = lambda stack: jax.make_array_from_process_local_data(
+            sh, np.asarray(stack, np.float32))
+        is_graph = hasattr(net.conf, "network_inputs")
+        with self.mesh:
+            step = start_step
+            while step < num_steps:
+                group = [batch_fn(s)
+                         for s in range(step, min(step + k, num_steps))]
+                xs = to_g(np.stack([g[0] for g in group]))
+                ys = to_g(np.stack([g[1] for g in group]))
+                if is_graph:
+                    name = net.conf.network_inputs[0]
+                    self._local_step.run_arrays({name: xs}, [ys])
+                else:
+                    self._local_step.run_arrays(xs, ys)
+                step += len(group)
+                if (self.checkpoint_dir and self.checkpoint_every
+                        and step % self.checkpoint_every == 0):
+                    self.save_checkpoint(step)
         return self
 
     def training_stats(self):

@@ -192,3 +192,30 @@ def test_training_stats_collection(tmp_path):
     tm.export_stats_html(out)
     content = open(out).read()
     assert "TrainingMaster timeline" in content and "<table" in content
+
+
+def test_training_master_local_sgd_matches_parallel_wrapper(rng):
+    """TrainingMaster(averaging_frequency=k) == ParallelWrapper(k) on
+    the same mesh + data (both drive LocalStepTrainer)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(HELPER)))
+    import distributed_worker as dw
+    import jax
+
+    from deeplearning4j_tpu.parallel import ParallelWrapper, make_mesh
+    from deeplearning4j_tpu.parallel.training_master import TrainingMaster
+
+    ds = jax.devices("cpu")[:4]
+    mesh1 = make_mesh(dp=4, devices=ds)
+    tm_net = dw.build_net()
+    tm = TrainingMaster(tm_net, mesh=mesh1, averaging_frequency=2)
+    tm.fit(lambda s: dw.global_batch(s), 4)
+
+    mesh2 = make_mesh(dp=4, devices=ds)
+    pw_net = dw.build_net()
+    batches = [dw.global_batch(s) for s in range(4)]
+    ParallelWrapper(pw_net, mesh=mesh2, averaging_frequency=2).fit(batches)
+
+    for a, b in zip(jax.tree_util.tree_leaves(tm_net.params),
+                    jax.tree_util.tree_leaves(pw_net.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
