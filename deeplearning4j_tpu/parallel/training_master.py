@@ -112,16 +112,24 @@ class TrainingMaster:
         self.net.states = self._replicated(self.net.states)
         self._staged = True
 
+    def _stage(self, a, spec):
+        """Host partition -> global device array with `spec` sharding,
+        cast to the net's dtype."""
+        import jax
+        import numpy as _np
+        from jax.sharding import NamedSharding
+
+        dtype = _np.dtype(getattr(self.net, "dtype", None) or _np.float32)
+        return jax.make_array_from_process_local_data(
+            NamedSharding(self.mesh, spec), np.asarray(a, dtype))
+
     def _global_batch(self, x_local, y_local):
         """Per-host partition -> global [G, ...] device arrays sharded
         over dp (the ExecuteWorkerFlatMap data-partition role)."""
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax.sharding import PartitionSpec as P
 
-        sh = NamedSharding(self.mesh, P("dp"))
-        to_g = lambda a: jax.make_array_from_process_local_data(
-            sh, np.asarray(a, np.float32))
-        return to_g(x_local), to_g(y_local)
+        return (self._stage(x_local, P("dp")),
+                self._stage(y_local, P("dp")))
 
     # ----------------------------------------------------------------- fit
     def fit(self, batch_fn: Callable[[int], Tuple], num_steps: int,
@@ -156,11 +164,12 @@ class TrainingMaster:
             raise NotImplementedError(
                 "line-search solvers are not supported under "
                 "TrainingMaster; use stochastic_gradient_descent")
+        if self.averaging_frequency > 1:
+            return self._fit_local_sgd(batch_fn, num_steps, start_step,
+                                       collect_training_stats)
         is_graph = hasattr(net.conf, "network_inputs")
         is_tbptt = getattr(net.conf, "backprop_type", None) \
             == "truncated_bptt"
-        if self.averaging_frequency > 1:
-            return self._fit_local_sgd(batch_fn, num_steps, start_step)
         with self.mesh:
             for step in range(start_step, num_steps):
                 t0 = time.perf_counter()
@@ -199,12 +208,14 @@ class TrainingMaster:
                     })
         return self
 
-    def _fit_local_sgd(self, batch_fn, num_steps, start_step):
+    def _fit_local_sgd(self, batch_fn, num_steps, start_step,
+                       collect_training_stats=False):
         """k-step local-SGD groups over the global mesh (the DCN
         compression role — see __init__). Reuses LocalStepTrainer's
         shard_map program; data stacked [k, G, ...] per group."""
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        import time
+
+        from jax.sharding import PartitionSpec as P
 
         from deeplearning4j_tpu.parallel.wrapper import LocalStepTrainer
 
@@ -212,26 +223,43 @@ class TrainingMaster:
         k = self.averaging_frequency
         if self._local_step is None:
             self._local_step = LocalStepTrainer(net, self.mesh)
-        sh = NamedSharding(self.mesh, P(None, "dp"))
-        to_g = lambda stack: jax.make_array_from_process_local_data(
-            sh, np.asarray(stack, np.float32))
         is_graph = hasattr(net.conf, "network_inputs")
+        every = self.checkpoint_every
         with self.mesh:
             step = start_step
             while step < num_steps:
+                t0 = time.perf_counter()
                 group = [batch_fn(s)
                          for s in range(step, min(step + k, num_steps))]
-                xs = to_g(np.stack([g[0] for g in group]))
-                ys = to_g(np.stack([g[1] for g in group]))
+                xs = self._stage(np.stack([g[0] for g in group]),
+                                 P(None, "dp"))
+                ys = self._stage(np.stack([g[1] for g in group]),
+                                 P(None, "dp"))
+                t1 = time.perf_counter()
                 if is_graph:
                     name = net.conf.network_inputs[0]
                     self._local_step.run_arrays({name: xs}, [ys])
                 else:
                     self._local_step.run_arrays(xs, ys)
+                if collect_training_stats:
+                    float(net.score())
+                t2 = time.perf_counter()
+                prev = step
                 step += len(group)
-                if (self.checkpoint_dir and self.checkpoint_every
-                        and step % self.checkpoint_every == 0):
+                # checkpoint when the group CROSSES a cadence boundary
+                # (group ends rarely align with checkpoint_every)
+                if (self.checkpoint_dir and every
+                        and prev // every != step // every):
                     self.save_checkpoint(step)
+                if collect_training_stats:
+                    self._stats.append({
+                        "step": step - len(group),
+                        "data_ms": (t1 - t0) * 1e3,
+                        "fit_ms": (t2 - t1) * 1e3,
+                        "listener_ms": 0.0,
+                        "checkpoint_ms":
+                            (time.perf_counter() - t2) * 1e3,
+                    })
         return self
 
     def training_stats(self):
