@@ -107,12 +107,54 @@ def bench_lstm(batch=64, seq_len=256, vocab=98, iters=30):
     return batch * seq_len * iters / dt, dt / iters, final_loss
 
 
+def bench_lenet(batch=4096, iters=40):
+    """BASELINE config #1: LeNet MNIST-shaped training throughput
+    (ref zoo/model/LeNet.java). Run with `python bench.py lenet`."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.zoo import LeNet
+
+    net = LeNet(num_classes=10, input_shape=(28, 28, 1)).init_model()
+    rng = np.random.default_rng(0)
+    x = jax.device_put(jnp.asarray(
+        rng.normal(size=(batch, 28, 28, 1)).astype(np.float32)))
+    y = jax.device_put(jnp.asarray(
+        np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]))
+    _ = float(jnp.sum(x[0, 0]))
+    loss = net.fit_batch((x, y))
+    _ = float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = net.fit_batch((x, y))
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss)
+    return batch * iters / dt, dt / iters, final_loss
+
+
 def main():
     import sys
 
     import jax
 
     dev = jax.devices()[0]
+    if len(sys.argv) > 1 and sys.argv[1] == "lenet":
+        ips, step_s, loss = bench_lenet()
+        base = BASELINES.get("lenet_mnist_train_images_per_sec")
+        print(json.dumps({
+            "metric": "lenet_mnist_train_images_per_sec",
+            "value": round(ips, 1),
+            "unit": "images/sec",
+            "vs_baseline": round(ips / base, 3) if base else 1.0,
+            "step_time_ms": round(step_s * 1e3, 2),
+            "final_loss": round(loss, 3),
+            "config": "batch=4096 f32 28x28",
+            "device": str(dev.device_kind),
+            "platform": str(dev.platform),
+            "jax": jax.__version__,
+        }))
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "lstm":
         tps, step_s, loss = bench_lstm()
         print(json.dumps({
