@@ -53,6 +53,11 @@ class EarlyStoppingTrainer:
             for batch in self.train_iterator:
                 self._fit_batch(batch)
                 score = net.score()
+                if score is None:
+                    # Parallel trainer with averaging_frequency=k buffers
+                    # the first k-1 batches, so no score exists yet; the
+                    # iteration conditions are only defined on real scores.
+                    continue
                 for c in cfg.iteration_termination_conditions:
                     if c.terminate(score):
                         reason = TerminationReason.ITERATION_TERMINATION

@@ -48,6 +48,25 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
         lib = ctypes.CDLL(out)
     except OSError:
         return None
+    try:
+        return _bind(lib)
+    except AttributeError:
+        # stale cached .so missing a symbol (e.g. a copied artifact with
+        # a newer mtime than the source): rebuild once from the current
+        # tree, then fall back to NumPy if it is still unloadable
+        try:
+            os.remove(out)
+            subprocess.run(
+                ["sh", os.path.join(_SRC_DIR, "build.sh"), out],
+                check=True, capture_output=True, timeout=120)
+            return _bind(ctypes.CDLL(out))
+        except (OSError, subprocess.SubprocessError, AttributeError):
+            return None
+
+
+def _bind(lib: ctypes.CDLL) -> Optional[ctypes.CDLL]:
+    if lib.dl4j_native_abi_version() != 1:
+        return None
     lib.dl4j_parse_csv_f32.restype = ctypes.c_int
     lib.dl4j_parse_csv_f32.argtypes = [
         ctypes.c_char_p, ctypes.c_int64, ctypes.c_char,
@@ -62,8 +81,6 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float),
         ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
         ctypes.c_float, ctypes.c_float]
-    if lib.dl4j_native_abi_version() != 1:
-        return None
     return lib
 
 

@@ -52,12 +52,28 @@ def _bn_train(x, gamma, beta, eps):
     return y, mean, var
 
 
+def _bn_stats(x, axes, st):
+    """Per-channel mean/var. Low-precision inputs (bf16/f16) use the
+    one-pass E[x^2]-E[x]^2 form with f32 accumulation — two sibling
+    reductions over one read, multi-output-fused by XLA, saving a full
+    HBM pass; the f32 accumulator's extra mantissa over the input dtype
+    bounds the cancellation below the input's own quantization. Full-
+    precision inputs use the two-pass mean-then-deviations form: at
+    x.dtype==f32 the one-pass form cancels catastrophically when
+    |mean| >> std (e.g. unnormalized ~1e4 inputs)."""
+    mean = jnp.mean(x, axis=axes, dtype=st)
+    if st == x.dtype:
+        var = jnp.mean(jnp.square(x - mean), axis=axes)
+    else:
+        mean2 = jnp.mean(jnp.square(x.astype(st)), axis=axes)
+        var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
+    return mean, var
+
+
 def _bn_fwd_impl(x, gamma, beta, eps):
     axes = tuple(range(x.ndim - 1))
     st = jnp.promote_types(x.dtype, jnp.float32)   # f32 accum; f64 in
-    mean = jnp.mean(x, axis=axes, dtype=st)        # gradcheck mode
-    mean2 = jnp.mean(jnp.square(x.astype(st)), axis=axes)
-    var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
+    mean, var = _bn_stats(x, axes, st)             # gradcheck mode
     r = lax.rsqrt(var + eps)
     scale = gamma.astype(st) * r
     shift = beta.astype(st) - mean * scale
@@ -143,14 +159,7 @@ class BatchNormalization(Layer):
         axes = tuple(range(x.ndim - 1))
 
         def batch_stats(x):
-            # one-pass E[x^2]-E[x]^2 (two sibling reductions over the same
-            # read, multi-output-fused by XLA) instead of jnp.var's
-            # mean-then-deviations second pass — BN is HBM-bound, so this
-            # saves a full activation read per BN in fwd and bwd
-            mean = jnp.mean(x, axis=axes, dtype=stat_dtype)
-            mean2 = jnp.mean(jnp.square(x.astype(stat_dtype)), axis=axes)
-            var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
-            return mean, var
+            return _bn_stats(x, axes, stat_dtype)
 
         if train:
             # fused-backward path (see _bn_train): gamma/beta as arrays

@@ -118,6 +118,11 @@ class BaseLineSearchOptimizer:
     def _accepted(self, alpha, step, grad):
         pass
 
+    def _restart(self, grad):
+        """Align solver bookkeeping with the steepest-descent direction
+        actually taken on the fallback branch (so _accepted doesn't
+        re-store the rejected direction / pre-restart history)."""
+
     def _alpha0(self) -> float:
         return 1.0
 
@@ -133,6 +138,7 @@ class BaseLineSearchOptimizer:
             # no decrease along d: restart from steepest descent
             self._state = None
             d = -grad
+            self._restart(grad)
             alpha, f_new = self.line_search.search(
                 lambda v: pb.value(v, x, y, fm, lm), flat, f0, grad, d,
                 self.net.conf.learning_rate)
@@ -176,6 +182,10 @@ class ConjugateGradient(BaseLineSearchOptimizer):
         self._g_last = grad
         self._d_last = d
         return d
+
+    def _restart(self, grad):
+        self._g_last = grad
+        self._d_last = -grad
 
     def _accepted(self, alpha, step, grad):
         self._state = (self._g_last, self._d_last)
@@ -221,6 +231,10 @@ class LBFGS(BaseLineSearchOptimizer):
     def step(self, x, y, fm=None, lm=None) -> float:
         self._flat_now = self.problem.flat_params()
         return super().step(x, y, fm, lm)
+
+    def _restart(self, grad):
+        self._hist = []
+        self._g_last = grad
 
     def _accepted(self, alpha, step, grad):
         self._state = (self._flat_now, self._g_last, self._hist)

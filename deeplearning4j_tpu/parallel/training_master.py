@@ -29,6 +29,7 @@ from __future__ import annotations
 import json
 import os
 import re
+from functools import partial
 from typing import Callable, Optional, Tuple
 
 import numpy as np
@@ -320,8 +321,8 @@ class TrainingMaster:
         rep = NamedSharding(self.mesh, P())
 
         if getattr(self, "_eval_fn", None) is None:
-            @jax.jit
-            def confusion_counts(params, states, x, y):
+            @partial(jax.jit, static_argnums=(4,))
+            def confusion_counts(params, states, x, y, has_mask, lm):
                 if is_graph:
                     name = net.conf.network_inputs[0]
                     acts, _, _ = net._forward(params, states, {name: x},
@@ -337,6 +338,12 @@ class TrainingMaster:
                 actual = jnp.argmax(y, axis=-1).reshape(-1)
                 onehot = (jax.nn.one_hot(actual, c)[:, :, None]
                           * jax.nn.one_hot(pred, c)[:, None, :])
+                if has_mask:
+                    # label mask [N,T] (or [N]): drop padded timesteps
+                    # exactly like Evaluation.eval(..., mask=lm) — any
+                    # nonzero mask value means "keep" (boolean semantics)
+                    keep = (lm.reshape(-1) != 0).astype(onehot.dtype)
+                    onehot = onehot * keep[:, None, None]
                 # global sum: GSPMD reduces over the dp-sharded batch
                 return jax.lax.with_sharding_constraint(
                     jnp.sum(onehot, axis=0), rep)
@@ -346,8 +353,17 @@ class TrainingMaster:
 
         with self.mesh:
             for step in range(num_steps):
-                x, y = self._global_batch(*batch_fn(step))
-                counts = confusion_counts(net.params, net.states, x, y)
+                # batch_fn follows the container convention
+                # (x, y[, features_mask[, labels_mask]]); like the
+                # containers' evaluate(), only the LABEL mask shapes the
+                # confusion counts (Evaluation.eval(..., mask=lm))
+                batch = batch_fn(step)
+                x, y = self._global_batch(batch[0], batch[1])
+                lm = batch[3] if len(batch) > 3 else None
+                if lm is not None:
+                    lm = self._stage(lm, P("dp"))
+                counts = confusion_counts(net.params, net.states, x, y,
+                                          lm is not None, lm)
                 m = np.asarray(self._host_leaf(counts)).astype(np.int64)
                 evaluation._ensure(m.shape[0])
                 evaluation.confusion.matrix += m

@@ -162,6 +162,38 @@ def test_training_master_distributed_evaluate(rng):
     assert 0.0 <= ev.accuracy() <= 1.0
 
 
+def test_training_master_masked_evaluate(rng):
+    """batch_fn may return the standard (x, y, fm, lm) tuple; the label
+    mask (index 3, per the container convention) drops padded rows from
+    the global confusion counts (round-3 advisor)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(HELPER)))
+    import distributed_worker as dw
+
+    from deeplearning4j_tpu.eval import Evaluation
+    from deeplearning4j_tpu.parallel.training_master import TrainingMaster
+
+    net = dw.build_net()
+    tm = TrainingMaster(net)
+    tm.fit(lambda s: dw.global_batch(s), 2)
+
+    masks = {}
+
+    def batch_fn(s):
+        x, y = dw.global_batch(200 + s)
+        lm = (rng.random(y.shape[0]) > 0.4).astype(np.float32)
+        masks[s] = (x, y, lm)
+        return x, y, None, lm
+
+    ev = tm.evaluate(batch_fn, 2)
+    expect = Evaluation()
+    for s in range(2):
+        x, y, lm = masks[s]
+        expect.eval(y, np.asarray(net.output(x)), mask=lm)
+    np.testing.assert_array_equal(ev.confusion.matrix,
+                                  expect.confusion.matrix)
+    assert ev.confusion.total() < sum(m[1].shape[0] for m in masks.values())
+
+
 def test_evaluation_merge():
     from deeplearning4j_tpu.eval import Evaluation
 

@@ -210,6 +210,54 @@ def test_early_stopping_parallel_trainer(rng):
     assert np.isfinite(result.best_model_score)
 
 
+def test_early_stopping_parallel_trainer_avg_freq_iteration_conditions(rng):
+    """averaging_frequency=k buffers the first k-1 batches, so net.score()
+    is None at those iterations — iteration termination conditions must be
+    skipped, not fed None (regression: TypeError in round-3 advisor)."""
+    import jax
+
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.earlystopping import (
+        EarlyStoppingConfiguration,
+        EarlyStoppingParallelTrainer,
+        InMemoryModelSaver,
+        MaxEpochsTerminationCondition,
+    )
+    from deeplearning4j_tpu.earlystopping.termination import (
+        InvalidScoreIterationTerminationCondition,
+        MaxScoreIterationTerminationCondition,
+    )
+    from deeplearning4j_tpu.nn.conf import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.parallel import make_mesh
+
+    ds = jax.devices("cpu")
+    if len(ds) < 2:
+        pytest.skip("need 2 cpu devices")
+    conf = (NeuralNetConfiguration.Builder().seed(3).updater("sgd")
+            .learning_rate(0.1).weight_init("xavier").list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=2, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = rng.normal(size=(16, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+    es_conf = (EarlyStoppingConfiguration.Builder()
+               .model_saver(InMemoryModelSaver())
+               .epoch_termination_conditions(
+                   MaxEpochsTerminationCondition(2))
+               .iteration_termination_conditions(
+                   MaxScoreIterationTerminationCondition(1e9),
+                   InvalidScoreIterationTerminationCondition())
+               .build())
+    trainer = EarlyStoppingParallelTrainer(
+        es_conf, net, [(x, y)] * 4,
+        mesh=make_mesh(dp=2, devices=ds[:2]), averaging_frequency=2)
+    result = trainer.fit()   # must not raise on the buffered batches
+    assert result.total_epochs <= 2
+    assert result.best_model is not None
+
+
 # ------------------------------------------------------------ profiler
 
 def test_profiler_listener(tmp_path, rng):

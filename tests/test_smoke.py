@@ -235,3 +235,21 @@ def test_graph_summary_and_evaluate(rng):
     net.fit([([x], [y])] * 30)
     ev = net.evaluate([([x], [y])])
     assert ev.confusion.total() == 32
+
+
+def test_batchnorm_large_mean_stability(rng):
+    """f32 batch-norm must normalize unnormalized-scale inputs
+    (|mean| >> std) without catastrophic cancellation in the variance
+    (round-3 advisor: one-pass E[x^2]-E[x]^2 at f32 collapses var)."""
+    from deeplearning4j_tpu.nn.layers.norm import BatchNormalization
+    from deeplearning4j_tpu.nn.conf.inputs import InputType as IT
+
+    x = (1.0e4 + rng.normal(size=(64, 8))).astype(np.float32)
+    bn = BatchNormalization()
+    bn.set_n_in(IT.feed_forward(8))
+    params = bn.init_params(None, IT.feed_forward(8))
+    state = bn.init_state(IT.feed_forward(8))
+    y, _ = bn.apply(params, x, train=True, state=state)
+    y = np.asarray(y)
+    assert np.all(np.abs(y.mean(axis=0)) < 1e-2)
+    assert np.all(np.abs(y.std(axis=0) - 1.0) < 0.05)

@@ -108,3 +108,41 @@ def test_optimization_algo_serde_roundtrip():
     js = net.conf.to_json()
     rt = MultiLayerConfiguration.from_json(js)
     assert rt.optimization_algo == "lbfgs"
+
+
+def test_restart_resets_solver_state(rng):
+    """When line search fails along the solver direction and the
+    steepest-descent fallback is taken, the stored state must reflect
+    the fallback direction, not the rejected one (round-3 advisor)."""
+    import jax.numpy as jnp
+
+    x, y = _data(rng)
+    cg = make_solver("conjugate_gradient", _net("conjugate_gradient"))
+    # prime state, then force the restart branch with a line search that
+    # always fails on the first (solver-direction) call
+    cg.step(x, y)
+    calls = {"n": 0}
+    orig = BackTrackLineSearch.search
+
+    def failing_first(self, f, x0, f0, g0, direction, alpha0=1.0):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return 0.0, f0
+        return orig(self, f, x0, f0, g0, direction, alpha0)
+
+    cg.line_search.search = failing_first.__get__(cg.line_search)
+    cg.step(x, y)
+    assert calls["n"] >= 2
+    g_stored, d_stored = cg._state
+    # after the restart, the stored direction is exactly -grad
+    np.testing.assert_allclose(np.asarray(d_stored),
+                               -np.asarray(g_stored), rtol=1e-6)
+
+    lb = make_solver("lbfgs", _net("lbfgs"))
+    lb.step(x, y)
+    lb.step(x, y)
+    assert lb._state[2]   # curvature history accumulated
+    calls["n"] = 0
+    lb.line_search.search = failing_first.__get__(lb.line_search)
+    lb.step(x, y)
+    assert lb._state[2] == []   # history cleared by the restart
