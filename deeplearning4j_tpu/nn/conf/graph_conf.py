@@ -90,6 +90,10 @@ class ComputationGraphConfiguration:
     tbptt_fwd_length: int = 20
     tbptt_back_length: int = 20
     pretrain: bool = False
+    # accelerated helper tier: "none" (default XLA per-layer path) or
+    # "fused" (graph-level conv+BN+act fusion — nn/helpers/; the
+    # ConvolutionLayer.java:74-84 helper hook, TPU-style)
+    helper_mode: str = "none"
 
     # ------------------------------------------------------------- topology
     def node(self, name: str) -> GraphNode:
@@ -318,6 +322,15 @@ class GraphBuilder:
         return self
 
     setOutputs = set_outputs
+
+    def helpers(self, mode: str) -> "GraphBuilder":
+        """Select the accelerated helper tier ('none' | 'fused') — the
+        ConvolutionLayer.java:74-84 helper hook, graph-level on TPU."""
+        if mode not in ("none", "fused"):
+            raise ValueError(
+                f"Unknown helper mode '{mode}'. Known: none, fused")
+        self._conf.helper_mode = mode
+        return self
 
     def set_input_types(self, **types: InputType) -> "GraphBuilder":
         self._conf.input_types.update(types)

@@ -85,6 +85,7 @@ class ComputationGraph:
         self._updaters: Optional[Dict[str, Any]] = None
         self._lr_score_factor = 1.0   # lr_policy="score" decay state
         self._best_score = None
+        self._fusion_plan = "uninit"   # helper tier (nn/helpers/)
 
     # ------------------------------------------------------------------ init
     def init(self, seed: Optional[int] = None) -> "ComputationGraph":
@@ -122,11 +123,46 @@ class ComputationGraph:
                    for l in jax.tree_util.tree_leaves(self.params))
 
     # --------------------------------------------------------------- forward
+    def _helper_plan(self):
+        """Lazily build the fusion plan when the helper tier is enabled
+        (conf `.helpers("fused")` or env DL4J_TPU_HELPERS — the ambient
+        default the reference gets from the CUDA backend's presence)."""
+        if self._fusion_plan == "uninit":
+            import os
+
+            mode = getattr(self.conf, "helper_mode", "none") or "none"
+            if mode == "none":
+                # ambient default only — an explicit .helpers() wins
+                mode = os.environ.get("DL4J_TPU_HELPERS", "none")
+            if mode not in ("none", "fused"):
+                raise ValueError(
+                    f"Unknown helper mode '{mode}' "
+                    "(conf.helper_mode / DL4J_TPU_HELPERS). "
+                    "Known: none, fused")
+            if mode == "fused":
+                from deeplearning4j_tpu.nn.helpers.fused_graph import (
+                    build_plan,
+                )
+                self._fusion_plan = build_plan(
+                    self.topo, self.conf.network_outputs)
+            else:
+                self._fusion_plan = None
+        return self._fusion_plan
+
     def _forward(self, params, states, inputs: Dict[str, Any], *, train,
                  rng, input_masks: Optional[Dict[str, Any]] = None,
-                 rnn_carries: Optional[Dict[str, Any]] = None):
+                 rnn_carries: Optional[Dict[str, Any]] = None,
+                 materialize_all: bool = False):
         """Pure forward over the DAG. Returns (activations dict,
         new_states, new_carries)."""
+        if self._helper_plan() is not None:
+            from deeplearning4j_tpu.nn.helpers.fused_graph import (
+                fused_forward,
+            )
+            return fused_forward(
+                self, params, states, inputs, train=train, rng=rng,
+                input_masks=input_masks, rnn_carries=rnn_carries,
+                materialize_all=materialize_all)
         acts: Dict[str, Any] = dict(inputs)
         masks: Dict[str, Any] = dict(input_masks or {})
         new_states: Dict[str, Any] = {}
@@ -138,39 +174,48 @@ class ComputationGraph:
         for i, node in enumerate(self.topo):
             xs = [acts[s] for s in node.inputs]
             in_masks = [masks.get(s) for s in node.inputs]
-            if node.kind == "layer":
-                x = xs[0]
-                m = in_masks[0]
-                if node.preprocessor is not None:
-                    x = node.preprocessor.preprocess(x)
-                    m = node.preprocessor.feed_forward_mask(m, None)
-                layer = node.obj
-                is_rnn = isinstance(layer, (LSTM, GravesBidirectionalLSTM))
-                if is_rnn:
-                    carry = (None if rnn_carries is None
-                             else rnn_carries.get(node.name))
-                    out, nc = layer.apply(params[node.name], x, train=train,
-                                          rng=rngs[i], state=carry, mask=m)
-                    new_carries[node.name] = nc
-                    new_states[node.name] = states[node.name]
-                else:
-                    st = states[node.name] if states[node.name] else None
-                    out, ns = layer.apply(params[node.name], x, train=train,
-                                          rng=rngs[i], state=st, mask=m)
-                    new_states[node.name] = (ns if ns is not None
-                                             else states[node.name])
-                acts[node.name] = out
-                masks[node.name] = layer.feed_forward_mask(m, None)
-            else:
-                v = node.obj
-                if isinstance(v, LastTimeStepVertex):
-                    m = (masks.get(v.mask_input)
-                         if v.mask_input else in_masks[0])
-                    acts[node.name] = v.apply(xs, mask=m)
-                else:
-                    acts[node.name] = v.apply(xs)
-                masks[node.name] = v.feed_forward_mask(in_masks, None)
+            self._exec_node(node, xs, in_masks, rngs[i], params, states,
+                            train, rnn_carries, acts, masks, new_states,
+                            new_carries)
         return acts, new_states, new_carries
+
+    def _exec_node(self, node, xs, in_masks, rng_i, params, states, train,
+                   rnn_carries, acts, masks, new_states, new_carries):
+        """Execute ONE node with resolved inputs, writing its activation,
+        mask, state, and carry. Shared by the default loop above and the
+        fused executor's fallback branch (nn/helpers/fused_graph.py)."""
+        if node.kind == "layer":
+            x = xs[0]
+            m = in_masks[0]
+            if node.preprocessor is not None:
+                x = node.preprocessor.preprocess(x)
+                m = node.preprocessor.feed_forward_mask(m, None)
+            layer = node.obj
+            is_rnn = isinstance(layer, (LSTM, GravesBidirectionalLSTM))
+            if is_rnn:
+                carry = (None if rnn_carries is None
+                         else rnn_carries.get(node.name))
+                out, nc = layer.apply(params[node.name], x, train=train,
+                                      rng=rng_i, state=carry, mask=m)
+                new_carries[node.name] = nc
+                new_states[node.name] = states[node.name]
+            else:
+                st = states[node.name] if states[node.name] else None
+                out, ns = layer.apply(params[node.name], x, train=train,
+                                      rng=rng_i, state=st, mask=m)
+                new_states[node.name] = (ns if ns is not None
+                                         else states[node.name])
+            acts[node.name] = out
+            masks[node.name] = layer.feed_forward_mask(m, None)
+        else:
+            v = node.obj
+            if isinstance(v, LastTimeStepVertex):
+                m = (masks.get(v.mask_input)
+                     if v.mask_input else in_masks[0])
+                acts[node.name] = v.apply(xs, mask=m)
+            else:
+                acts[node.name] = v.apply(xs)
+            masks[node.name] = v.feed_forward_mask(in_masks, None)
 
     # ------------------------------------------------------------------ loss
     def _output_layer_nodes(self) -> List[GraphNode]:
@@ -439,7 +484,8 @@ class ComputationGraph:
         inputs = {name: jnp.asarray(x, self.dtype)
                   for name, x in zip(self.conf.network_inputs, xs)}
         acts, _, _ = self._forward(self.params, self.states, inputs,
-                                   train=train, rng=None)
+                                   train=train, rng=None,
+                                   materialize_all=True)
         return acts
 
     def evaluate(self, iterator, evaluation=None, output_index: int = 0):

@@ -1,0 +1,28 @@
+"""Accelerated helper tier (the cuDNN-helper analogue, TPU-native).
+
+Parity: the reference attaches optional accelerated helpers to layers —
+ConvolutionLayer.java:74-84 instantiates CudnnConvolutionHelper when the
+CUDA backend is present, falling back to the built-in path otherwise
+(CudnnConvolutionHelper.java:54,120). Here the built-in path is XLA
+(`lax.conv_general_dilated` — already MXU-tiled), and the helper tier is
+a graph-level fusion pass (fused_graph.py, built on the custom-VJP
+pipeline op in fused_ops.py) that cuts HBM pass count by fusing BN
+statistics, BN application, activation, and residual adds into the
+convolutions' prologues/epilogues, plus hand-written Pallas kernels for
+the shapes where manual tiling wins (pallas_conv.py). Selection mirrors
+the reference: opt-in per network via `.helpers("fused")` on the graph
+builder (or env DL4J_TPU_HELPERS), default off.
+"""
+
+from deeplearning4j_tpu.nn.helpers.fused_ops import (
+    bn_affine,
+    fused_conv,
+)
+from deeplearning4j_tpu.nn.helpers.pallas_conv import (
+    fused_conv_bn_act,
+    fused_conv1x1,
+    fused_conv3x3,
+)
+
+__all__ = ["bn_affine", "fused_conv", "fused_conv_bn_act",
+           "fused_conv1x1", "fused_conv3x3"]

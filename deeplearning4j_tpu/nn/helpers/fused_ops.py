@@ -1,0 +1,187 @@
+"""Fused conv+BN+activation pipeline op (the pass-count eliminator).
+
+The helper tier's core primitive (parity role: CudnnConvolutionHelper /
+CudnnBatchNormalizationHelper fused algorithms, hooked at
+ConvolutionLayer.java:74-84). Profiling (PERF.md) showed the flagship's
+MFU ceiling is NOT kernel quality — XLA fuses `relu(scale*x+shift)` into
+a conv's operand and channel-statistics into its output in ONE
+roofline-bound pass — but the *materialization structure* of autodiff:
+the per-layer conv→BN→relu composition saves both the conv output and
+the normalized activation as residuals and splits stats/apply into
+separate HBM passes.
+
+This module restructures the chain so activations cross layer
+boundaries as (raw conv output, per-channel affine) pairs:
+
+    u     = relu(scale*x + shift [+ scale2*x2 + shift2])  # BN-apply(+add)
+    y_raw = conv(u, W) + b                                # the only pass
+    ssum, ssq = channel sums of y_raw                     # stats epilogue
+    scale', shift' = f(gamma, beta, ssum, ssq)            # [C] algebra
+
+`fused_conv` is a custom-VJP op: u is NEVER saved — the backward
+recomputes it from the raw inputs (an elementwise chain XLA fuses into
+the wgrad/dgrad convolutions' operands). Residuals are only tensors
+that already exist (the raw inputs and the output). The BN backward
+needs no hand-derivation: cotangents for scale/shift arrive from the
+NEXT conv's backward via the chain rule, and the statistics cotangents
+(dssum, dssq) flow into THIS op's backward — the classic fused-BN
+backward emerges from composition (verified exact against the naive
+layer composition in tests/test_helpers.py).
+
+The convolution itself is `lax.conv_general_dilated` (MXU-tiled by XLA,
+97.6% MFU in isolation — PERF.md) for any kernel/stride; grad convs are
+derived with `jax.vjp` so stride/padding transposition is always right.
+An opt-in Pallas kernel path exists in pallas_conv.py for the shapes
+where hand tiling wins.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_DIMS_NHWC = ("NHWC", "HWIO", "NHWC")
+
+
+def _conv(u, w, stride, padding):
+    return lax.conv_general_dilated(
+        u, w, window_strides=stride, padding=padding,
+        dimension_numbers=_DIMS_NHWC)
+
+
+def _prologue(x, scale, shift, x2, scale2, shift2, relu):
+    u = x
+    if scale is not None:
+        u = u * scale.astype(x.dtype) + shift.astype(x.dtype)
+    if x2 is not None:
+        if scale2 is not None:
+            u = u + (x2 * scale2.astype(x.dtype) + shift2.astype(x.dtype))
+        else:
+            u = u + x2
+    if relu:
+        u = jnp.maximum(u, 0)
+    return u
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9, 10, 11))
+def fused_conv(x, w, b, scale, shift, x2, scale2, shift2,
+               stride, padding, relu, with_stats):
+    """y_raw = conv(act(scale*x+shift [+ scale2*x2+shift2]), w) + b,
+    plus channel sum/sumsq of y_raw and the materialized activation u.
+
+    x/x2: [B,H,W,C] raw (pre-BN) inputs; scale*/shift*: [C] f32 affines
+    (None = plain tensor); stride: (sh, sw); padding: lax padding
+    ('SAME'/'VALID'/explicit); relu: bool; with_stats: compute channel
+    statistics of y (train-mode BN needs them; eval mode passes False).
+
+    Returns (y_raw [B,H,W,N], ssum [N] f32, ssq [N] f32, u). `u` is the
+    post-activation tensor — callers that don't use it get it DCE'd by
+    XLA; residual branches use it as the materialized skip tensor.
+    """
+    return _fwd_impl(x, w, b, scale, shift, x2, scale2, shift2,
+                     stride, padding, relu, with_stats)
+
+
+def _fwd_impl(x, w, b, scale, shift, x2, scale2, shift2,
+              stride, padding, relu, with_stats):
+    u = _prologue(x, scale, shift, x2, scale2, shift2, relu)
+    y = _conv(u, w, stride, padding)
+    if b is not None:
+        y = y + b.astype(y.dtype)
+    if with_stats:
+        yf = y.astype(jnp.float32)
+        ssum = jnp.sum(yf, axis=(0, 1, 2))
+        ssq = jnp.sum(yf * yf, axis=(0, 1, 2))
+    else:
+        n = y.shape[-1]
+        ssum = jnp.zeros((n,), jnp.float32)
+        ssq = jnp.zeros((n,), jnp.float32)
+    return y, ssum, ssq, u
+
+
+def _fused_conv_fwd(x, w, b, scale, shift, x2, scale2, shift2,
+                    stride, padding, relu, with_stats):
+    out = _fwd_impl(x, w, b, scale, shift, x2, scale2, shift2,
+                    stride, padding, relu, with_stats)
+    y = out[0]
+    # residuals: x, x2 and y are buffers that exist anyway (y is the
+    # next layer's x; x2 is an earlier op's output); the rest is [C]
+    return out, (x, w, b, scale, shift, x2, scale2, shift2, y)
+
+
+def _fused_conv_bwd(stride, padding, relu, with_stats, res, cts):
+    x, w, b, scale, shift, x2, scale2, shift2, y = res
+    dy, dssum, dssq, du_out = cts
+    dtype = x.dtype
+
+    # effective output cotangent: dy + statistics contributions (fused
+    # by XLA into the grad convolutions' operand reads)
+    ybar = dy
+    if with_stats:
+        ybar = (ybar.astype(jnp.float32) + dssum
+                + 2.0 * y.astype(jnp.float32) * dssq).astype(dtype)
+
+    # recompute u (never materialized in fwd residuals)
+    u = _prologue(x, scale, shift, x2, scale2, shift2, relu)
+    db = (jnp.sum(ybar.astype(jnp.float32), axis=(0, 1, 2))
+          if b is not None else None)
+
+    du = jax.vjp(lambda uu: _conv(uu, w, stride, padding), u)[1](ybar)[0]
+    dw = jax.vjp(lambda ww: _conv(u, ww, stride, padding), w)[1](ybar)[0]
+
+    if du_out is not None:
+        du = du + du_out.astype(du.dtype)
+    if relu:
+        du = du * (u > 0).astype(dtype)
+
+    def branch_grads(xb, sb):
+        if sb is None:
+            return du, None, None
+        ds = jnp.sum(xb.astype(jnp.float32) * du.astype(jnp.float32),
+                     axis=(0, 1, 2))
+        dt = jnp.sum(du.astype(jnp.float32), axis=(0, 1, 2))
+        return du * sb.astype(dtype), ds, dt
+
+    dx, dscale, dshift = branch_grads(x, scale)
+    if x2 is not None:
+        dx2, dscale2, dshift2 = branch_grads(x2, scale2)
+    else:
+        dx2 = dscale2 = dshift2 = None
+    return dx, dw, db, dscale, dshift, dx2, dscale2, dshift2
+
+
+fused_conv.defvjp(_fused_conv_fwd, _fused_conv_bwd)
+
+
+# ---------------------------------------------------------------- helpers
+
+
+def bn_affine(gamma, beta, ssum, ssq, count, eps):
+    """Fold BN statistics into the next conv's prologue affine.
+    Returns (scale [C] f32, shift [C] f32, mean, var) — all
+    differentiable, so BN's backward-through-statistics emerges from the
+    chain rule through these [C]-vector ops.
+
+    Numerical note: the variance is necessarily the one-pass
+    E[x^2]-E[x]^2 form (the fused epilogue can only accumulate sums),
+    which cancels in f32 when |mean| >> std. Inside a BN'd network the
+    conv outputs this normalizes are standardized-scale by construction,
+    so the regime does not arise past the first layer; nets fed raw
+    ~1e4-scale inputs should standardize them (NormalizerStandardize) or
+    keep the default executor, whose two-pass f32 path (norm.py
+    _bn_stats) is immune."""
+    mean = ssum / count
+    var = jnp.maximum(ssq / count - mean * mean, 0.0)
+    scale = gamma.astype(jnp.float32) * lax.rsqrt(var + eps)
+    shift = beta.astype(jnp.float32) - mean * scale
+    return scale, shift, mean, var
+
+
+def bn_affine_inference(gamma, beta, mean, var, eps):
+    scale = gamma.astype(jnp.float32) * lax.rsqrt(
+        var.astype(jnp.float32) + eps)
+    shift = beta.astype(jnp.float32) - mean.astype(jnp.float32) * scale
+    return scale, shift

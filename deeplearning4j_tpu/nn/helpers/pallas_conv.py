@@ -1,0 +1,347 @@
+"""Pallas fused convolution pipeline kernels (TPU).
+
+The cuDNN-helper tier reborn for TPU (parity role:
+CudnnConvolutionHelper.java:54,120 hooked at ConvolutionLayer.java:74-84;
+CudnnBatchNormalizationHelper.java). The reference's helper accelerates
+each layer in isolation; on TPU the win is *pass-count*: a ResNet-style
+conv→BN→relu(→add) chain costs XLA one conv kernel plus 2-3 full
+HBM passes of BN-stats / BN-apply / add glue per activation (profiled in
+PERF.md at ~70% of the step). These kernels collapse the chain:
+
+  - PROLOGUE: the convolution reads its input as raw pre-BN conv output
+    and applies `relu(scale*x + shift [+ residual])` per tile as it
+    loads — the BN-apply/activation/residual-add pass never exists as an
+    HBM round-trip.
+  - MATMUL: 1x1 convs are row-major matmuls over M=B*H*W; 3x3 convs
+    build an im2col tile in VMEM from a DMA'd halo block and do one
+    [M_tile, 9C] x [9C, N] MXU matmul.
+  - EPILOGUE: per-channel sum / sum-of-squares of the conv output are
+    accumulated while output tiles are still in VMEM — the next BN's
+    statistics pass never re-reads the activation. Optionally the
+    post-prologue input `u` is written out (`emit_u`), materializing the
+    residual-branch tensor for the block's skip connection as a
+    byproduct instead of a separate add+relu pass.
+
+Activations therefore cross layers as (raw conv output, per-channel
+affine) pairs; batch-norm becomes [C]-vector algebra between kernels.
+
+All matmuls accumulate in f32 (`preferred_element_type`); statistics are
+taken over the rounded compute-dtype output so results match the XLA
+path's numerics. Kernels run in interpret mode off-TPU so the same tests
+drive both.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _pick_mt(m: int, k: int) -> int:
+    """Largest MXU-friendly row tile that divides M (keeps x/u tiles a
+    few MB in VMEM)."""
+    budget = max(128, min(1024, (4 * 1024 * 1024) // max(1, 2 * k)))
+    for mt in (1024, 512, 256, 128):
+        if mt <= budget and m % mt == 0:
+            return mt
+    for mt in (64, 32, 16, 8):
+        if m % mt == 0:
+            return mt
+    return m
+
+
+# --------------------------------------------------------------- 1x1 conv
+
+
+def _conv1x1_kernel(x_ref, w_ref, b_ref, s_ref, t_ref, a_ref,
+                    y_ref, ssum_ref, ssq_ref, u_ref,
+                    *, affine, add, relu, emit_u, compute_dtype):
+    i = pl.program_id(0)
+    x = x_ref[:]
+    if affine:
+        u = x * s_ref[:].astype(x.dtype) + t_ref[:].astype(x.dtype)
+    else:
+        u = x
+    if add:
+        u = u + a_ref[:]
+    if relu:
+        u = jnp.maximum(u, 0)
+    if emit_u:
+        u_ref[:] = u
+    acc = jnp.dot(u, w_ref[:], preferred_element_type=jnp.float32)
+    acc = acc + b_ref[:]
+    y = acc.astype(compute_dtype)
+    y_ref[:] = y
+    yf = y.astype(jnp.float32)
+
+    @pl.when(i == 0)
+    def _():
+        ssum_ref[:] = jnp.zeros_like(ssum_ref)
+        ssq_ref[:] = jnp.zeros_like(ssq_ref)
+
+    ssum_ref[:] += jnp.sum(yf, axis=0, keepdims=True)
+    ssq_ref[:] += jnp.sum(yf * yf, axis=0, keepdims=True)
+
+
+def fused_conv1x1(x, w, b, scale=None, shift=None, add=None,
+                  relu: bool = False, emit_u: bool = False):
+    """Fused 1x1 conv: y = relu(scale*x + shift [+ add]) @ w + b, with
+    per-channel sum/sumsq of y as byproducts.
+
+    x: [M, K] (flattened B*H*W rows), w: [K, N], b: [N] or None,
+    scale/shift: [K] f32, add: [M, K] (plain tensor, post-affine,
+    pre-relu). Returns (y [M, N], ssum [N] f32, ssq [N] f32, u or None).
+    """
+    m, k = x.shape
+    n = w.shape[1]
+    dtype = x.dtype
+    mt = _pick_mt(m, max(k, n))
+    affine = scale is not None
+    grid = (m // mt,)
+
+    b2 = jnp.zeros((1, n), jnp.float32) if b is None else \
+        b.reshape(1, n).astype(jnp.float32)
+    s2 = scale.reshape(1, k).astype(jnp.float32) if affine else \
+        jnp.zeros((1, k), jnp.float32)
+    t2 = shift.reshape(1, k).astype(jnp.float32) if affine else \
+        jnp.zeros((1, k), jnp.float32)
+    a2 = add if add is not None else jnp.zeros((1, k), dtype)
+
+    const = lambda *_: (0, 0)
+    row = lambda i: (i, 0)
+    in_specs = [
+        pl.BlockSpec((mt, k), row, memory_space=pltpu.VMEM),
+        pl.BlockSpec((k, n), const, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, n), const, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, k), const, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, k), const, memory_space=pltpu.VMEM),
+        pl.BlockSpec((mt, k) if add is not None else (1, k),
+                     row if add is not None else const,
+                     memory_space=pltpu.VMEM),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((m, n), dtype),
+        jax.ShapeDtypeStruct((1, n), jnp.float32),
+        jax.ShapeDtypeStruct((1, n), jnp.float32),
+        jax.ShapeDtypeStruct((m, k) if emit_u else (1, k), dtype),
+    ]
+    out_specs = [
+        pl.BlockSpec((mt, n), row, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, n), const, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, n), const, memory_space=pltpu.VMEM),
+        pl.BlockSpec((mt, k) if emit_u else (1, k),
+                     row if emit_u else const, memory_space=pltpu.VMEM),
+    ]
+    kernel = functools.partial(
+        _conv1x1_kernel, affine=affine, add=add is not None, relu=relu,
+        emit_u=emit_u, compute_dtype=dtype)
+    y, ssum, ssq, u = pl.pallas_call(
+        kernel, grid=grid, in_specs=in_specs, out_specs=out_specs,
+        out_shape=out_shape, interpret=_interpret(),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m * k * n,
+            bytes_accessed=(m * k + k * n + m * n) * x.dtype.itemsize,
+            transcendentals=0),
+    )(x, w, b2, s2, t2, a2)
+    return y, ssum[0], ssq[0], (u if emit_u else None)
+
+
+# --------------------------------------------------------------- 3x3 conv
+
+
+def _pick_th(h: int) -> int:
+    for th in (16, 14, 8, 7, 4):
+        if h % th == 0:
+            return th
+    return h
+
+
+def _conv3x3_kernel(x_ref, xprev_ref, xnext_ref, w_ref, b_ref, s_ref, t_ref,
+                    y_ref, ssum_ref, ssq_ref,
+                    scratch, col_scratch,
+                    *, th, h, wdim, c, n, affine, relu, compute_dtype):
+    i = pl.program_id(1)
+    # assemble the haloed tile in VMEM scratch; the 1-row halo blocks
+    # come from clamped index maps (clamped rows are garbage, masked
+    # below together with the SAME zero-padding)
+    scratch[0:1, 1:wdim + 1, :] = xprev_ref[0]
+    scratch[1:th + 1, 1:wdim + 1, :] = x_ref[0]
+    scratch[th + 1:th + 2, 1:wdim + 1, :] = xnext_ref[0]
+    xs = scratch[:]
+    if affine:
+        u = xs * s_ref[:].astype(xs.dtype) + t_ref[:].astype(xs.dtype)
+    else:
+        u = xs
+    if relu:
+        u = jnp.maximum(u, 0)
+    # zero everything outside the image (SAME padding + unDMA'd halo
+    # rows at the image edge; garbage in those slots is masked here).
+    # 3D int32 iota: Mosaic can't minor-expand an i1 vector, so the mask
+    # is built at full rank from 32-bit iotas.
+    shp = (th + 2, wdim + 2, c)
+    rows = jax.lax.broadcasted_iota(jnp.int32, shp, 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, shp, 1)
+    grow = rows + i * th - 1
+    valid = ((grow >= 0) & (grow < h) & (cols >= 1) & (cols <= wdim))
+    u = jnp.where(valid, u, 0)
+
+    # im2col through VMEM scratch: direct register concat of the 9
+    # shifted views trips Mosaic lane-offset alignment, so each tap is
+    # written at its [tap*c] channel offset (stores realign) and the
+    # buffer is read back as one [th*wdim, 9c] matmul operand
+    for tap, (dh, dw) in enumerate((dh, dw) for dh in range(3)
+                                   for dw in range(3)):
+        col_scratch[:, :, tap * c:(tap + 1) * c] = \
+            u[dh:dh + th, dw:dw + wdim, :]
+    col = col_scratch[:].reshape(th * wdim, 9 * c)
+    acc = jnp.dot(col, w_ref[:], preferred_element_type=jnp.float32)
+    acc = acc + b_ref[:]
+    y = acc.astype(compute_dtype)
+    y_ref[:] = y.reshape(1, th, wdim, n)
+    yf = y.astype(jnp.float32)
+
+    @pl.when((pl.program_id(0) == 0) & (i == 0))
+    def _():
+        ssum_ref[:] = jnp.zeros_like(ssum_ref)
+        ssq_ref[:] = jnp.zeros_like(ssq_ref)
+
+    ssum_ref[:] += jnp.sum(yf, axis=0, keepdims=True)
+    ssq_ref[:] += jnp.sum(yf * yf, axis=0, keepdims=True)
+
+
+def fused_conv3x3(x, w, b, scale=None, shift=None, relu: bool = False):
+    """Fused 3x3 SAME stride-1 conv over NHWC with affine+relu prologue
+    and channel-stats epilogue.
+
+    x: [B, H, W, C]; w: [3, 3, C, N] (HWIO); b: [N] or None.
+    Returns (y [B, H, W, N], ssum [N] f32, ssq [N] f32).
+    """
+    bsz, h, wd, c = x.shape
+    n = w.shape[-1]
+    dtype = x.dtype
+    th = _pick_th(h)
+    affine = scale is not None
+    grid = (bsz, h // th)
+
+    wmat = w.reshape(9 * c, n)
+    b2 = jnp.zeros((1, n), jnp.float32) if b is None else \
+        b.reshape(1, n).astype(jnp.float32)
+    s2 = (scale.reshape(1, 1, c).astype(jnp.float32) if affine
+          else jnp.zeros((1, 1, c), jnp.float32))
+    t2 = (shift.reshape(1, 1, c).astype(jnp.float32) if affine
+          else jnp.zeros((1, 1, c), jnp.float32))
+
+    const2 = lambda *_: (0, 0)
+    const3 = lambda *_: (0, 0, 0)
+    in_specs = [
+        pl.BlockSpec((1, th, wd, c), lambda bi, i: (bi, i, 0, 0),
+                     memory_space=pltpu.VMEM),
+        # 1-row halo blocks: block shape 1 along H makes the block index
+        # a row index, so clamped maps fetch rows i*th-1 / (i+1)*th
+        pl.BlockSpec((1, 1, wd, c),
+                     lambda bi, i: (bi, jnp.maximum(i * th - 1, 0), 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, wd, c),
+                     lambda bi, i: (bi, jnp.minimum((i + 1) * th, h - 1),
+                                    0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((9 * c, n), const2, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, n), const2, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, c), const3, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, c), const3, memory_space=pltpu.VMEM),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((bsz, h, wd, n), dtype),
+        jax.ShapeDtypeStruct((1, n), jnp.float32),
+        jax.ShapeDtypeStruct((1, n), jnp.float32),
+    ]
+    out_specs = [
+        pl.BlockSpec((1, th, wd, n), lambda bi, i: (bi, i, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, n), const2, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, n), const2, memory_space=pltpu.VMEM),
+    ]
+    kernel = functools.partial(
+        _conv3x3_kernel, th=th, h=h, wdim=wd, c=c, n=n, affine=affine,
+        relu=relu, compute_dtype=dtype)
+    y, ssum, ssq = pl.pallas_call(
+        kernel, grid=grid, in_specs=in_specs, out_specs=out_specs,
+        out_shape=out_shape, interpret=_interpret(),
+        scratch_shapes=[pltpu.VMEM((th + 2, wd + 2, c), dtype),
+                        pltpu.VMEM((th, wd, 9 * c), dtype)],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * bsz * h * wd * 9 * c * n,
+            bytes_accessed=(bsz * h * wd * (c + n) + 9 * c * n)
+            * x.dtype.itemsize,
+            transcendentals=0),
+    )(x, x, x, wmat, b2, s2, t2)
+    return y, ssum[0], ssq[0]
+
+
+# -------------------------------------------------------- reference impls
+
+
+def ref_fused_conv1x1(x, w, b, scale=None, shift=None, add=None,
+                      relu=False, emit_u=False):
+    """Pure-jnp oracle for fused_conv1x1 (same rounding points)."""
+    u = x
+    if scale is not None:
+        u = u * scale.astype(x.dtype) + shift.astype(x.dtype)
+    if add is not None:
+        u = u + add
+    if relu:
+        u = jnp.maximum(u, 0)
+    y = (jnp.dot(u, w, preferred_element_type=jnp.float32)
+         + (0 if b is None else b.astype(jnp.float32))).astype(x.dtype)
+    yf = y.astype(jnp.float32)
+    return y, jnp.sum(yf, 0), jnp.sum(yf * yf, 0), (u if emit_u else None)
+
+
+def ref_fused_conv3x3(x, w, b, scale=None, shift=None, relu=False):
+    """Pure-lax oracle for fused_conv3x3."""
+    from jax import lax
+
+    u = x
+    if scale is not None:
+        u = u * scale.astype(x.dtype) + shift.astype(x.dtype)
+    if relu:
+        u = jnp.maximum(u, 0)
+    y = lax.conv_general_dilated(
+        u, w, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32)
+    y = (y + (0 if b is None else b.astype(jnp.float32))).astype(x.dtype)
+    yf = y.astype(jnp.float32)
+    return y, jnp.sum(yf, (0, 1, 2)), jnp.sum(yf * yf, (0, 1, 2))
+
+
+def fused_conv_bn_act(x, w, b, gamma, beta, mean, var, eps=1e-5,
+                      relu=True):
+    """Convenience wrapper: one conv with BN-apply(+relu) of the GIVEN
+    stats fused into the *output* side — used for inference-mode single
+    convs. scale/shift fold BN into the next conv's prologue in the
+    training pipeline; this helper is the standalone-layer form.
+
+    w: [K, N] (1x1 conv over flattened rows) or [3, 3, C, N]."""
+    if w.ndim == 4 and w.shape[:2] != (3, 3):
+        raise ValueError(
+            f"pallas helper supports 1x1 (2-D w) or 3x3 kernels, got "
+            f"{w.shape[:2]}; use the XLA path for other geometries")
+    s = gamma * jax.lax.rsqrt(var + eps)
+    t = beta - mean * s
+    if w.ndim == 2:
+        y, _, _, _ = fused_conv1x1(x, w, b)
+    else:
+        y, _, _ = fused_conv3x3(x, w, b)
+    out = y * s.astype(y.dtype) + t.astype(y.dtype)
+    if relu:
+        out = jnp.maximum(out, 0)
+    return out

@@ -38,7 +38,8 @@ class ZooModel:
     def __init__(self, num_classes: Optional[int] = None,
                  input_shape: Optional[Sequence[int]] = None,
                  seed: int = 123, updater: str = "nesterovs",
-                 learning_rate: float = 1e-2, compute_dtype=None):
+                 learning_rate: float = 1e-2, compute_dtype=None,
+                 helpers: Optional[str] = None):
         if num_classes is not None:
             self.num_classes = num_classes
         if input_shape is not None:
@@ -47,6 +48,7 @@ class ZooModel:
         self.updater = updater
         self.learning_rate = learning_rate
         self.compute_dtype = compute_dtype   # e.g. "bfloat16" for MXU speed
+        self.helpers = helpers               # accelerated tier (nn/helpers)
 
     def conf(self):
         raise NotImplementedError
@@ -59,6 +61,21 @@ class ZooModel:
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
         c = self.conf()
+        if self.helpers is not None:
+            if self.helpers not in ("none", "fused"):
+                raise ValueError(
+                    f"Unknown helper mode '{self.helpers}'. "
+                    "Known: none, fused")
+            if hasattr(c, "helper_mode"):
+                c.helper_mode = self.helpers
+            else:
+                import logging
+
+                logging.getLogger("deeplearning4j_tpu").warning(
+                    "%s: helpers=%r requested but the model is layer-list "
+                    "based; the helper tier currently applies to "
+                    "ComputationGraph models only", type(self).__name__,
+                    self.helpers)
         if isinstance(c, ComputationGraphConfiguration):
             return ComputationGraph(c, compute_dtype=self.compute_dtype).init()
         return MultiLayerNetwork(c, compute_dtype=self.compute_dtype).init()

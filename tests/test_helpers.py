@@ -1,0 +1,183 @@
+"""Helper-tier equivalence tests (the CuDNNGradientChecks pattern,
+ref /root/reference/deeplearning4j-cuda/src/test/java/org/deeplearning4j/
+gradientcheck/CuDNNGradientChecks.java): the fused executor must match
+the default XLA per-layer path — losses, parameter updates, running
+stats, inference outputs — on ResNet-style conv/BN/add graphs."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf import InputType
+from deeplearning4j_tpu.nn.conf.graph_conf import GraphBuilder
+from deeplearning4j_tpu.nn.conf.graph_vertices import ElementWiseVertex
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers import (
+    ActivationLayer,
+    BatchNormalization,
+    ConvolutionLayer,
+    GlobalPoolingLayer,
+    OutputLayer,
+    SubsamplingLayer,
+)
+
+
+def _conv_bn(gb, name, inp, n_out, kernel, stride=(1, 1), activation="relu"):
+    gb.add_layer(f"{name}_conv",
+                 ConvolutionLayer(n_out=n_out, kernel_size=kernel,
+                                  stride=stride, convolution_mode="same",
+                                  activation="identity"), inp)
+    gb.add_layer(f"{name}_bn", BatchNormalization(), f"{name}_conv")
+    if activation:
+        gb.add_layer(f"{name}_act", ActivationLayer(activation=activation),
+                     f"{name}_bn")
+        return f"{name}_act"
+    return f"{name}_bn"
+
+
+def _mini_resnet(helpers: str, seed=7):
+    """Stem + one conv-block + one identity-block + head — every fusion
+    pattern: plain input conv, affine+relu prologue, add(bn, bn),
+    add(bn, plain), strided downsample."""
+    gb = (NeuralNetConfiguration.Builder().seed(seed).updater("sgd")
+          .learning_rate(0.05).weight_init("relu").activation("relu")
+          .graph_builder().add_inputs("input"))
+    x = _conv_bn(gb, "stem", "input", 8, (3, 3), stride=(2, 2))
+    gb.add_layer("pool", SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2),
+                                          convolution_mode="same"), x)
+    # conv block (projection shortcut): add(bn, bn)
+    a = _conv_bn(gb, "b0a", "pool", 8, (1, 1))
+    b = _conv_bn(gb, "b0b", a, 8, (3, 3))
+    c = _conv_bn(gb, "b0c", b, 16, (1, 1), activation=None)
+    sc = _conv_bn(gb, "b0sc", "pool", 16, (1, 1), activation=None)
+    gb.add_vertex("b0_add", ElementWiseVertex(op="add"), c, sc)
+    gb.add_layer("b0_out", ActivationLayer(activation="relu"), "b0_add")
+    # identity block: add(bn, plain)
+    a = _conv_bn(gb, "b1a", "b0_out", 8, (1, 1))
+    b = _conv_bn(gb, "b1b", a, 8, (3, 3))
+    c = _conv_bn(gb, "b1c", b, 16, (1, 1), activation=None)
+    gb.add_vertex("b1_add", ElementWiseVertex(op="add"), c, "b0_out")
+    gb.add_layer("b1_out", ActivationLayer(activation="relu"), "b1_add")
+    gb.add_layer("gap", GlobalPoolingLayer(pooling_type="avg"), "b1_out")
+    gb.add_layer("out", OutputLayer(n_out=5, loss="mcxent"), "gap")
+    gb.set_outputs("out")
+    gb.set_input_types(input=InputType.convolutional(16, 16, 3))
+    gb.helpers(helpers)
+    return ComputationGraph(gb.build()).init()
+
+
+def _data(rng, n=8):
+    x = rng.normal(size=(n, 16, 16, 3)).astype(np.float32)
+    y = np.eye(5, dtype=np.float32)[rng.integers(0, 5, n)]
+    return x, y
+
+
+def test_plan_covers_patterns():
+    net = _mini_resnet("fused")
+    plan = net._helper_plan()
+    assert plan is not None
+    assert len(plan.conv) == 8          # all 8 convs fused
+    assert len(plan.bn) == 8
+    assert set(plan.vadd) == {"b0_add", "b1_add"}
+    assert "b0_out" in plan.vact and "b1_out" in plan.vact
+
+
+def test_fused_training_matches_default(rng):
+    x, y = _data(rng)
+    nets = {m: _mini_resnet(m) for m in ("none", "fused")}
+    for _ in range(4):
+        losses = {m: float(n.fit_batch(([x], [y]))) for m, n in nets.items()}
+        np.testing.assert_allclose(losses["none"], losses["fused"],
+                                   rtol=5e-4)
+    # parameters agree after 4 updates
+    pn = jax.tree_util.tree_leaves_with_path(nets["none"].params)
+    pf = jax.tree_util.tree_leaves(nets["fused"].params)
+    for (path, a), b in zip(pn, pf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=5e-5, err_msg=str(path))
+    # BN running stats agree
+    sn = jax.tree_util.tree_leaves_with_path(nets["none"].states)
+    sf = jax.tree_util.tree_leaves(nets["fused"].states)
+    for (path, a), b in zip(sn, sf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=5e-5, err_msg=str(path))
+
+
+def test_fused_inference_matches_default(rng):
+    x, y = _data(rng)
+    nets = {m: _mini_resnet(m) for m in ("none", "fused")}
+    nets["none"].fit_batch(([x], [y]))
+    nets["fused"].fit_batch(([x], [y]))
+    # eval mode uses running stats through the inference affine
+    on = np.asarray(nets["none"].output(x))
+    of = np.asarray(nets["fused"].output(x))
+    np.testing.assert_allclose(on, of, rtol=2e-3, atol=2e-5)
+
+
+def test_fused_feed_forward_materializes_all(rng):
+    x, _ = _data(rng)
+    net = _mini_resnet("fused")
+    acts = net.feed_forward(x)
+    default = _mini_resnet("none")
+    acts_d = default.feed_forward(x)
+    assert set(acts_d) <= set(acts)
+    np.testing.assert_allclose(np.asarray(acts["b1_out"]),
+                               np.asarray(acts_d["b1_out"]),
+                               rtol=2e-3, atol=2e-5)
+
+
+def test_helper_mode_serde_roundtrip():
+    net = _mini_resnet("fused")
+    from deeplearning4j_tpu.nn.conf.graph_conf import (
+        ComputationGraphConfiguration,
+    )
+
+    rt = ComputationGraphConfiguration.from_json(net.conf.to_json())
+    assert rt.helper_mode == "fused"
+    rt2 = ComputationGraphConfiguration.from_yaml(net.conf.to_yaml())
+    assert rt2.helper_mode == "fused"
+
+
+@pytest.mark.parametrize("stride,relu,two_branch", [
+    ((1, 1), True, True),
+    ((2, 2), True, False),
+    ((1, 1), False, False),
+])
+def test_gradcheck_fused_conv(rng, stride, relu, two_branch):
+    """Gradient check of the hand-written custom VJP against autodiff of
+    the identical forward implementation (CuDNNGradientChecks.java
+    style) — every input and every output cotangent path (y, stats, u)
+    is exercised."""
+    from deeplearning4j_tpu.nn.helpers.fused_ops import _fwd_impl, fused_conv
+
+    x = jnp.asarray(rng.normal(size=(2, 6, 6, 4)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(3, 3, 4, 5)) * 0.2, jnp.float32)
+    b = jnp.asarray(rng.normal(size=(5,)), jnp.float32)
+    s1 = jnp.asarray(rng.normal(size=(4,)) * 0.3 + 1, jnp.float32)
+    t1 = jnp.asarray(rng.normal(size=(4,)) * 0.2, jnp.float32)
+    if two_branch:
+        x2 = jnp.asarray(rng.normal(size=(2, 6, 6, 4)), jnp.float32)
+        s2 = jnp.asarray(rng.normal(size=(4,)) * 0.3 + 1, jnp.float32)
+        t2 = jnp.asarray(rng.normal(size=(4,)) * 0.2, jnp.float32)
+    else:
+        x2 = s2 = t2 = None
+
+    def mk(op):
+        def f(*a):
+            y, ssum, ssq, u = op(*a, x2, s2, t2, stride, "SAME", relu,
+                                 True)
+            # exercise every output cotangent incl. stats and u
+            return (jnp.sum(y * y) + jnp.sum(ssum * ssum)
+                    + 0.1 * jnp.sum(ssq) + jnp.sum(u))
+        return f
+
+    args = (x, w, b, s1, t1)
+    g_custom = jax.grad(mk(fused_conv), argnums=tuple(range(5)))(*args)
+    g_auto = jax.grad(mk(_fwd_impl), argnums=tuple(range(5)))(*args)
+    for i, (a, e) in enumerate(zip(g_custom, g_auto)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=2e-4, atol=2e-5,
+                                   err_msg=f"arg {i}")
