@@ -48,14 +48,24 @@ PEAK_FLOPS = {
 }
 
 
-def bench_resnet50(batch=128, hw=224, iters=30, compute_dtype="bfloat16"):
-    """Steady-state training-step throughput, batch resident on device."""
+def bench_resnet50(batch=128, hw=224, iters=32, unroll=4,
+                   compute_dtype="bfloat16"):
+    """Steady-state training-step throughput, batch resident on device.
+
+    Runs the fused helper tier (nn/helpers) and `unroll` grad-over-flat
+    train steps per dispatch — the shape of a real training loop, which
+    syncs with the host every few steps, not every step; through the dev
+    tunnel this also amortizes the ~5 ms/dispatch RTT + buffer-handle
+    marshaling that single-step dispatch pays (PERF.md)."""
+    import functools
+
     import jax
     import jax.numpy as jnp
 
     from __graft_entry__ import _flagship
 
-    net, _, _ = _flagship(batch=batch, hw=hw, compute_dtype=compute_dtype)
+    net, _, _ = _flagship(batch=batch, hw=hw, compute_dtype=compute_dtype,
+                          helpers="fused")
     rng = np.random.default_rng(0)
     x = jax.device_put(jnp.asarray(
         rng.normal(size=(batch, hw, hw, 3)).astype(np.float32)))
@@ -63,13 +73,47 @@ def bench_resnet50(batch=128, hw=224, iters=30, compute_dtype="bfloat16"):
         np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)]))
     _ = float(jnp.sum(x[0, 0, 0]))   # force staging complete
 
-    loss, _ = net._train_step({"input": x}, [y])  # warmup/compile
-    _ = float(loss)
+    chain = net._flat_chain_obj()
+    assert chain is not None, "flagship must be flat-chain eligible"
+    from deeplearning4j_tpu.nn.updater import schedule_lr
 
+    cd = net.compute_dtype
+
+    def one_step(flat, uflat, states, step):
+        from deeplearning4j_tpu.nn.dtype import cast_floating
+
+        def loss_flat(fl):
+            params = cast_floating(chain.unravel(fl), cd)
+            loss, (ns, _) = net._loss_fn(
+                params, states, {"input": x.astype(cd)}, [y], None, None,
+                None, rnn_carries=None)
+            return loss.astype(net.dtype), ns
+
+        (loss, ns), g = jax.value_and_grad(loss_flat, has_aux=True)(flat)
+        lr = schedule_lr(net.conf, step)
+        deltas, new_u = chain.updater.update(g, uflat, flat, lr, step)
+        return flat + deltas, new_u, ns, loss
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def k_steps(flat, uflat, states, step):
+        loss = None
+        for i in range(unroll):
+            flat, uflat, states, loss = one_step(flat, uflat, states,
+                                                 step + i)
+        return flat, uflat, states, loss
+
+    flat = chain.ravel(net.params)
+    uflat = chain.ravel_upd(net.updater_states)
+    states = net.states
+    step0 = jnp.asarray(0, jnp.int32)
+    flat, uflat, states, loss = k_steps(flat, uflat, states, step0)
+    _ = float(loss)   # warmup/compile barrier
+
+    assert iters % unroll == 0
     t0 = time.perf_counter()
-    loss = None
-    for _ in range(iters):
-        loss, _ = net._train_step({"input": x}, [y])
+    for it in range(iters // unroll):
+        flat, uflat, states, loss = k_steps(
+            flat, uflat, states, jnp.asarray((it + 1) * unroll, jnp.int32))
     final_loss = float(loss)   # host fetch: true end-of-work barrier
     dt = time.perf_counter() - t0
     assert np.isfinite(final_loss), f"non-finite loss {final_loss}"

@@ -90,10 +90,12 @@ class ComputationGraphConfiguration:
     tbptt_fwd_length: int = 20
     tbptt_back_length: int = 20
     pretrain: bool = False
-    # accelerated helper tier: "none" (default XLA per-layer path) or
-    # "fused" (graph-level conv+BN+act fusion — nn/helpers/; the
-    # ConvolutionLayer.java:74-84 helper hook, TPU-style)
-    helper_mode: str = "none"
+    # accelerated helper tier (the ConvolutionLayer.java:74-84 helper
+    # hook, TPU-style — nn/helpers/): "none" (default XLA per-layer
+    # path), "fused" (graph-level conv+BN+act fusion), or "pallas"
+    # (fused + hand-written backward kernels, single-chip); "" = unset
+    # (the DL4J_TPU_HELPERS ambient default may apply)
+    helper_mode: str = ""
 
     # ------------------------------------------------------------- topology
     def node(self, name: str) -> GraphNode:
@@ -326,10 +328,9 @@ class GraphBuilder:
     def helpers(self, mode: str) -> "GraphBuilder":
         """Select the accelerated helper tier ('none' | 'fused') — the
         ConvolutionLayer.java:74-84 helper hook, graph-level on TPU."""
-        if mode not in ("none", "fused"):
-            raise ValueError(
-                f"Unknown helper mode '{mode}'. Known: none, fused")
-        self._conf.helper_mode = mode
+        from deeplearning4j_tpu.nn.helpers import validate_helper_mode
+
+        self._conf.helper_mode = validate_helper_mode(mode) or "none"
         return self
 
     def set_input_types(self, **types: InputType) -> "GraphBuilder":

@@ -72,9 +72,11 @@ class ComputationGraph:
         if conf.input_types:
             self.node_types, self._layer_in_types = conf.resolve_shapes(
                 return_layer_inputs=True)
-        self.params: Optional[Dict[str, Any]] = None
+        self._params: Optional[Dict[str, Any]] = None
         self.states: Optional[Dict[str, Any]] = None
-        self.updater_states: Optional[Dict[str, Any]] = None
+        self._upd_states: Optional[Dict[str, Any]] = None
+        self._flat_train = None       # (flat params, flat updater state)
+        self._flat_chain = "uninit"   # grad-over-flat carrier (updater/)
         self.rnn_states: Optional[Dict[str, Any]] = None
         self.iteration = 0
         self.epoch = 0
@@ -86,6 +88,48 @@ class ComputationGraph:
         self._lr_score_factor = 1.0   # lr_policy="score" decay state
         self._best_score = None
         self._fusion_plan = "uninit"   # helper tier (nn/helpers/)
+
+    # -------------------------------------------------- params (flat carry)
+    # The train step carries ONE flat parameter/updater-state vector when
+    # the configuration allows (updater/flat_chain.py — the UpdaterBlock
+    # flattened-view role); `params`/`updater_states` materialize the
+    # usual per-layer trees on demand. Any external access drops the flat
+    # carry, since the caller may mutate the returned tree.
+    def _materialize_flat(self):
+        if self._flat_train is not None:
+            chain = self._flat_chain
+            flat, uflat = self._flat_train
+            self._params = chain.unravel(flat)
+            self._upd_states = chain.unravel_upd(uflat, self._upd_states)
+            self._flat_train = None
+
+    @property
+    def params(self):
+        self._materialize_flat()
+        return self._params
+
+    @params.setter
+    def params(self, value):
+        self._flat_train = None
+        self._params = value
+
+    @property
+    def updater_states(self):
+        self._materialize_flat()
+        return self._upd_states
+
+    @updater_states.setter
+    def updater_states(self, value):
+        self._flat_train = None
+        self._upd_states = value
+
+    def _flat_chain_obj(self):
+        if self._flat_chain == "uninit":
+            from deeplearning4j_tpu.nn.updater.flat_chain import (
+                FlatTrainChain,
+            )
+            self._flat_chain = FlatTrainChain.build(self)
+        return self._flat_chain
 
     # ------------------------------------------------------------------ init
     def init(self, seed: Optional[int] = None) -> "ComputationGraph":
@@ -130,21 +174,22 @@ class ComputationGraph:
         if self._fusion_plan == "uninit":
             import os
 
-            mode = getattr(self.conf, "helper_mode", "none") or "none"
-            if mode == "none":
-                # ambient default only — an explicit .helpers() wins
-                mode = os.environ.get("DL4J_TPU_HELPERS", "none")
-            if mode not in ("none", "fused"):
-                raise ValueError(
-                    f"Unknown helper mode '{mode}' "
-                    "(conf.helper_mode / DL4J_TPU_HELPERS). "
-                    "Known: none, fused")
-            if mode == "fused":
+            from deeplearning4j_tpu.nn.helpers import validate_helper_mode
+
+            mode = validate_helper_mode(
+                getattr(self.conf, "helper_mode", ""))
+            if not mode:
+                # env is the ambient default for UNSET nets only; an
+                # explicit .helpers("none") stays "none"
+                mode = validate_helper_mode(
+                    os.environ.get("DL4J_TPU_HELPERS", "")) or "none"
+            if mode in ("fused", "pallas"):
                 from deeplearning4j_tpu.nn.helpers.fused_graph import (
                     build_plan,
                 )
                 self._fusion_plan = build_plan(
-                    self.topo, self.conf.network_outputs)
+                    self.topo, self.conf.network_outputs,
+                    impl="pallas" if mode == "pallas" else "xla")
             else:
                 self._fusion_plan = None
         return self._fusion_plan
@@ -322,21 +367,83 @@ class ComputationGraph:
 
         return jax.jit(step_fn, donate_argnums=(0, 1, 2))
 
+    def _build_flat_train_step(self, with_carries: bool, chain):
+        """Grad-over-flat variant of the train step: differentiates
+        through chain.unravel so gradients arrive as ONE flat vector and
+        the update rule is a single elementwise chain — no per-step
+        concats/slices (updater/flat_chain.py)."""
+        conf = self.conf
+        cd = self.compute_dtype
+
+        def loss_for_grad(flat, states, inputs, labels, rng, fmasks,
+                          lmasks, carries):
+            params = chain.unravel(flat)
+            if cd is not None:
+                from deeplearning4j_tpu.nn.dtype import cast_floating
+                params = cast_floating(params, cd)
+                inputs = cast_floating(inputs, cd)
+                carries = cast_floating(carries, cd)
+            loss, (new_states, new_carries) = self._loss_fn(
+                params, states, inputs, labels, rng, fmasks, lmasks,
+                rnn_carries=carries)
+            if cd is not None:
+                from deeplearning4j_tpu.nn.dtype import cast_floating
+                new_carries = cast_floating(new_carries, self.dtype)
+                loss = loss.astype(self.dtype)
+            return loss, (new_states, new_carries)
+
+        def step_fn(flat, uflat, states, step, inputs, labels,
+                    fmasks, lmasks, rng, carries, lr_scale):
+            (loss, (new_states, new_carries)), g = jax.value_and_grad(
+                loss_for_grad, has_aux=True)(
+                    flat, states, inputs, labels, rng, fmasks, lmasks,
+                    carries if with_carries else None)
+            g = self._clip_grads(g)
+            lr = schedule_lr(conf, step) * lr_scale
+            deltas, new_u = chain.updater.update(g, uflat, flat, lr, step)
+            return flat + deltas, new_u, new_states, new_carries, loss
+
+        return jax.jit(step_fn, donate_argnums=(0, 1, 2))
+
     def _train_step(self, inputs, labels, fmasks=None, lmasks=None,
                     carries=None):
         # cache key includes frozen flags: they're baked into the trace
         frozen_sig = tuple(sorted(n.name for n in self.topo
                                   if n.kind == "layer" and n.obj.frozen))
-        key = ("train_c" if carries is not None else "train", frozen_sig)
-        if key not in self._jit_cache:
-            self._jit_cache[key] = self._build_train_step(carries is not None)
+        chain = self._flat_chain_obj() if not frozen_sig else None
         self._rng, sub = jax.random.split(self._rng)
-        (self.params, self.updater_states, self.states, new_carries,
-         loss) = self._jit_cache[key](
-            self.params, self.updater_states, self.states,
-            jnp.asarray(self.iteration, jnp.int32), inputs, labels,
-            fmasks, lmasks, sub, carries,
-            jnp.asarray(self._lr_score_factor, jnp.float32))
+        if chain is not None:
+            key = ("train_flat_c" if carries is not None else "train_flat",)
+            if key not in self._jit_cache:
+                self._jit_cache[key] = self._build_flat_train_step(
+                    carries is not None, chain)
+            if self._flat_train is None:
+                self._flat_train = (chain.ravel(self._params),
+                                    chain.ravel_upd(self._upd_states))
+                # keep only a structure skeleton: the live state is the
+                # flat carry; the original buffers are freed
+                self._upd_states = chain.upd_skeleton(self._upd_states)
+            flat, uflat = self._flat_train
+            new_flat, new_u, self.states, new_carries, loss = \
+                self._jit_cache[key](
+                    flat, uflat, self.states,
+                    jnp.asarray(self.iteration, jnp.int32), inputs,
+                    labels, fmasks, lmasks, sub, carries,
+                    jnp.asarray(self._lr_score_factor, jnp.float32))
+            self._flat_train = (new_flat, new_u)
+            self._params = None
+        else:
+            key = ("train_c" if carries is not None else "train",
+                   frozen_sig)
+            if key not in self._jit_cache:
+                self._jit_cache[key] = self._build_train_step(
+                    carries is not None)
+            (self.params, self.updater_states, self.states, new_carries,
+             loss) = self._jit_cache[key](
+                self.params, self.updater_states, self.states,
+                jnp.asarray(self.iteration, jnp.int32), inputs, labels,
+                fmasks, lmasks, sub, carries,
+                jnp.asarray(self._lr_score_factor, jnp.float32))
         self.iteration += 1
         self._score = loss
         self._apply_score_decay(loss)

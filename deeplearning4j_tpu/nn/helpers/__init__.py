@@ -14,6 +14,20 @@ the reference: opt-in per network via `.helpers("fused")` on the graph
 builder (or env DL4J_TPU_HELPERS), default off.
 """
 
+HELPER_MODES = ("none", "fused", "pallas")
+
+
+def validate_helper_mode(mode: str) -> str:
+    """Shared whitelist for the helper tier ('' / None = unset)."""
+    if mode in ("", None):
+        return ""
+    if mode not in HELPER_MODES:
+        raise ValueError(
+            f"Unknown helper mode '{mode}'. "
+            f"Known: {', '.join(HELPER_MODES)}")
+    return mode
+
+
 from deeplearning4j_tpu.nn.helpers.fused_ops import (
     bn_affine,
     fused_conv,
@@ -24,5 +38,6 @@ from deeplearning4j_tpu.nn.helpers.pallas_conv import (
     fused_conv3x3,
 )
 
-__all__ = ["bn_affine", "fused_conv", "fused_conv_bn_act",
-           "fused_conv1x1", "fused_conv3x3"]
+__all__ = ["HELPER_MODES", "validate_helper_mode", "bn_affine",
+           "fused_conv", "fused_conv_bn_act", "fused_conv1x1",
+           "fused_conv3x3"]

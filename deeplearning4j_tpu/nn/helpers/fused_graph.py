@@ -46,6 +46,7 @@ class ConvSpec:
 @dataclass
 class Plan:
     """Static fusion plan: node-name -> role."""
+    impl: str = "xla"         # "xla" | "pallas" (kernel tier for bwd)
     conv: Dict[str, ConvSpec] = field(default_factory=dict)
     bn: Dict[str, str] = field(default_factory=dict)      # bn -> conv src
     vact: Dict[str, str] = field(default_factory=dict)    # act -> src node
@@ -65,7 +66,7 @@ def _consumers(topo) -> Dict[str, List[str]]:
     return out
 
 
-def build_plan(topo, network_outputs) -> Optional[Plan]:
+def build_plan(topo, network_outputs, impl: str = "xla") -> Optional[Plan]:
     """Pattern-match fusable chains over the topo order. Conservative:
     a conv is fused only when its sole consumer is a vanilla
     BatchNormalization; BN/act/add nodes become virtual only when the
@@ -78,7 +79,7 @@ def build_plan(topo, network_outputs) -> Optional[Plan]:
     by_name = {n.name: n for n in topo}
     cons = _consumers(topo)
     outputs = set(network_outputs)
-    plan = Plan()
+    plan = Plan(impl=impl)
 
     def conv_eligible(n) -> bool:
         l = n.obj
@@ -204,7 +205,7 @@ def fused_forward(net, params, states, inputs, *, train, rng,
             p = params[name]
             y, ssum, ssq, u = fused_conv(
                 x, p["W"], p["b"], s1, t1, x2, s2, t2,
-                spec.stride, spec.padding, e.relu, train)
+                spec.stride, spec.padding, e.relu, train, plan.impl)
             raws[name] = y
             stats[name] = (ssum, ssq)
             if src not in acts and (e.relu or len(e.terms) > 1

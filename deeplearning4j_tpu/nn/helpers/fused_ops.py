@@ -66,9 +66,9 @@ def _prologue(x, scale, shift, x2, scale2, shift2, relu):
     return u
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9, 10, 11))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9, 10, 11, 12))
 def fused_conv(x, w, b, scale, shift, x2, scale2, shift2,
-               stride, padding, relu, with_stats):
+               stride, padding, relu, with_stats, impl="xla"):
     """y_raw = conv(act(scale*x+shift [+ scale2*x2+shift2]), w) + b,
     plus channel sum/sumsq of y_raw and the materialized activation u.
 
@@ -80,6 +80,10 @@ def fused_conv(x, w, b, scale, shift, x2, scale2, shift2,
     Returns (y_raw [B,H,W,N], ssum [N] f32, ssq [N] f32, u). `u` is the
     post-activation tensor — callers that don't use it get it DCE'd by
     XLA; residual branches use it as the materialized skip tensor.
+
+    impl: "xla" composes lax ops (XLA fuses them); "pallas" additionally
+    routes the backward of 1x1 stride-1 convs through the hand-written
+    dgrad/wgrad kernels in pallas_conv.py (single-chip TPU path).
     """
     return _fwd_impl(x, w, b, scale, shift, x2, scale2, shift2,
                      stride, padding, relu, with_stats)
@@ -103,7 +107,7 @@ def _fwd_impl(x, w, b, scale, shift, x2, scale2, shift2,
 
 
 def _fused_conv_fwd(x, w, b, scale, shift, x2, scale2, shift2,
-                    stride, padding, relu, with_stats):
+                    stride, padding, relu, with_stats, impl="xla"):
     out = _fwd_impl(x, w, b, scale, shift, x2, scale2, shift2,
                     stride, padding, relu, with_stats)
     y = out[0]
@@ -112,10 +116,16 @@ def _fused_conv_fwd(x, w, b, scale, shift, x2, scale2, shift2,
     return out, (x, w, b, scale, shift, x2, scale2, shift2, y)
 
 
-def _fused_conv_bwd(stride, padding, relu, with_stats, res, cts):
+def _fused_conv_bwd(stride, padding, relu, with_stats, impl, res, cts):
     x, w, b, scale, shift, x2, scale2, shift2, y = res
     dy, dssum, dssq, du_out = cts
     dtype = x.dtype
+
+    if (impl == "pallas" and w.ndim == 4 and w.shape[:2] == (1, 1)
+            and tuple(stride) == (1, 1)):
+        return _bwd_pallas_1x1(x, w, b, scale, shift, x2, scale2, shift2,
+                               y, dy, dssum, dssq, du_out, relu,
+                               with_stats)
 
     # effective output cotangent: dy + statistics contributions (fused
     # by XLA into the grad convolutions' operand reads)
@@ -151,6 +161,38 @@ def _fused_conv_bwd(stride, padding, relu, with_stats, res, cts):
     else:
         dx2 = dscale2 = dshift2 = None
     return dx, dw, db, dscale, dshift, dx2, dscale2, dshift2
+
+
+def _bwd_pallas_1x1(x, w, b, scale, shift, x2, scale2, shift2, y, dy,
+                    dssum, dssq, du_out, relu, with_stats):
+    """Backward via the fused Pallas dgrad/wgrad kernels: each big
+    tensor is read once per kernel; ybar and du never round-trip HBM
+    (see pallas_conv.py)."""
+    from deeplearning4j_tpu.nn.helpers.pallas_conv import (
+        dgrad_conv1x1,
+        wgrad_conv1x1,
+    )
+
+    bsz, h, wd, k = x.shape
+    m = bsz * h * wd
+    n = w.shape[-1]
+    w2 = w.reshape(k, n)
+    dy2 = dy.reshape(m, n)
+    y2 = y.reshape(m, n)
+    st = (dssum, dssq) if with_stats else (None, None)
+    duo = None if du_out is None else du_out.reshape(m, k)
+    dx1, dx2, ds1, dt1, ds2, dt2, db = dgrad_conv1x1(
+        dy2, y2, w2, x.reshape(m, k),
+        None if x2 is None else x2.reshape(m, k), duo,
+        scale, shift, scale2, shift2, st[0], st[1], relu)
+    dw = wgrad_conv1x1(
+        dy2, y2, x.reshape(m, k),
+        None if x2 is None else x2.reshape(m, k),
+        scale, shift, scale2, shift2, st[0], st[1], relu)
+    return (dx1.reshape(x.shape), dw.reshape(w.shape).astype(w.dtype),
+            db.astype(jnp.float32) if b is not None else None,
+            ds1, dt1,
+            None if x2 is None else dx2.reshape(x2.shape), ds2, dt2)
 
 
 fused_conv.defvjp(_fused_conv_fwd, _fused_conv_bwd)

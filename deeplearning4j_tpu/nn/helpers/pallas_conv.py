@@ -287,6 +287,232 @@ def fused_conv3x3(x, w, b, scale=None, shift=None, relu: bool = False):
     return y, ssum[0], ssq[0]
 
 
+# ------------------------------------------------------------ 1x1 backward
+#
+# The backward of fused_conv costs XLA several full HBM passes: the
+# effective cotangent ybar = dy + dssum + 2*y*dssq is materialized
+# (needed by both grad convs), the input gradient du round-trips HBM
+# before the mask/scale chain, and dx1/dx2 are separate passes. These
+# kernels fold everything around the two matmuls:
+#   dgrad: ybar recomputed in-prologue (reads dy, y) -> du = ybar@W^T
+#          -> epilogue: +du_out, relu mask from recomputed u (reads
+#          x[,x2]), writes dx1[, dx2], accumulates ds/dt/db.
+#   wgrad: u and ybar recomputed in-prologue -> dW += u^T @ ybar.
+# Each big tensor is read once per kernel, nothing extra is written.
+
+
+def _dgrad1x1_kernel(dy_ref, y_ref, w_ref, x_ref, x2_ref, duo_ref,
+                     s1_ref, t1_ref, s2_ref, t2_ref, dsum_ref, dsq_ref,
+                     dx1_ref, dx2_ref, ds1_ref, dt1_ref, ds2_ref,
+                     dt2_ref, db_ref,
+                     *, aff1, aff2, has_x2, has_duo, relu, with_stats,
+                     compute_dtype):
+    i = pl.program_id(0)
+    dyf = dy_ref[:].astype(jnp.float32)
+    if with_stats:
+        dyf = (dyf + dsum_ref[:]
+               + 2.0 * y_ref[:].astype(jnp.float32) * dsq_ref[:])
+    ybar = dyf.astype(compute_dtype)
+
+    @pl.when(i == 0)
+    def _():
+        for r in (ds1_ref, dt1_ref, ds2_ref, dt2_ref, db_ref):
+            r[:] = jnp.zeros_like(r)
+
+    db_ref[:] += jnp.sum(dyf, axis=0, keepdims=True)
+    du = jax.lax.dot_general(
+        ybar, w_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    if has_duo:
+        du = du + duo_ref[:].astype(jnp.float32)
+    x = x_ref[:]
+    if aff1:
+        u = x * s1_ref[:].astype(x.dtype) + t1_ref[:].astype(x.dtype)
+    else:
+        u = x
+    if has_x2:
+        x2 = x2_ref[:]
+        if aff2:
+            u = u + (x2 * s2_ref[:].astype(x.dtype)
+                     + t2_ref[:].astype(x.dtype))
+        else:
+            u = u + x2
+    if relu:
+        # compare in f32: Mosaic lacks bf16 vector compares on some targets
+        du = jnp.where(u.astype(jnp.float32) > 0, du, 0.0)
+    duf = du
+    if aff1:
+        ds1_ref[:] += jnp.sum(x.astype(jnp.float32) * duf, axis=0,
+                              keepdims=True)
+        dt1_ref[:] += jnp.sum(duf, axis=0, keepdims=True)
+        dx1_ref[:] = (duf * s1_ref[:]).astype(compute_dtype)
+    else:
+        dx1_ref[:] = duf.astype(compute_dtype)
+    if has_x2:
+        if aff2:
+            ds2_ref[:] += jnp.sum(x2_ref[:].astype(jnp.float32) * duf,
+                                  axis=0, keepdims=True)
+            dt2_ref[:] += jnp.sum(duf, axis=0, keepdims=True)
+            dx2_ref[:] = (duf * s2_ref[:]).astype(compute_dtype)
+        else:
+            dx2_ref[:] = duf.astype(compute_dtype)
+
+
+def dgrad_conv1x1(dy, y, w, x, x2=None, du_out=None, scale=None,
+                  shift=None, scale2=None, shift2=None, dssum=None,
+                  dssq=None, relu=False):
+    """Fused input-gradient of fused_conv (1x1, stride 1): one pass over
+    (dy, y, x[, x2]) producing dx1[, dx2] plus the [C]-sized ds/dt/db
+    reductions. Returns (dx1, dx2, ds1, dt1, ds2, dt2, db)."""
+    m, n = dy.shape
+    k = w.shape[0]
+    dtype = dy.dtype
+    mt = _pick_mt(m, max(k, n))
+    aff1 = scale is not None
+    aff2 = scale2 is not None
+    has_x2 = x2 is not None
+    has_duo = du_out is not None
+    with_stats = dssum is not None
+    grid = (m // mt,)
+
+    z1k = jnp.zeros((1, k), jnp.float32)
+    z1n = jnp.zeros((1, n), jnp.float32)
+    fill = lambda v, z: z if v is None else v.reshape(z.shape).astype(
+        jnp.float32)
+    zmk = jnp.zeros((1, k), dtype)
+
+    const = lambda *_: (0, 0)
+    row = lambda i: (i, 0)
+    rowk = pl.BlockSpec((mt, k), row, memory_space=pltpu.VMEM)
+    rown = pl.BlockSpec((mt, n), row, memory_space=pltpu.VMEM)
+    c1k = pl.BlockSpec((1, k), const, memory_space=pltpu.VMEM)
+    c1n = pl.BlockSpec((1, n), const, memory_space=pltpu.VMEM)
+    in_specs = [
+        rown, rown,
+        pl.BlockSpec((k, n), const, memory_space=pltpu.VMEM),
+        rowk,
+        rowk if has_x2 else c1k,
+        rowk if has_duo else c1k,
+        c1k, c1k, c1k, c1k, c1n, c1n,
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((m, k), dtype),
+        jax.ShapeDtypeStruct((m, k) if has_x2 else (1, k), dtype),
+        jax.ShapeDtypeStruct((1, k), jnp.float32),
+        jax.ShapeDtypeStruct((1, k), jnp.float32),
+        jax.ShapeDtypeStruct((1, k), jnp.float32),
+        jax.ShapeDtypeStruct((1, k), jnp.float32),
+        jax.ShapeDtypeStruct((1, n), jnp.float32),
+    ]
+    out_specs = [
+        rowk,
+        rowk if has_x2 else pl.BlockSpec((1, k), const,
+                                         memory_space=pltpu.VMEM),
+        c1k, c1k, c1k, c1k, c1n,
+    ]
+    kernel = functools.partial(
+        _dgrad1x1_kernel, aff1=aff1, aff2=aff2, has_x2=has_x2,
+        has_duo=has_duo, relu=relu, with_stats=with_stats,
+        compute_dtype=dtype)
+    outs = pl.pallas_call(
+        kernel, grid=grid, in_specs=in_specs, out_specs=out_specs,
+        out_shape=out_shape, interpret=_interpret(),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m * k * n,
+            bytes_accessed=(2 * m * n + k * n + (2 + has_x2 + has_duo)
+                            * m * k) * dy.dtype.itemsize,
+            transcendentals=0),
+    )(dy, y, w,
+      x.reshape(m, k),
+      x2.reshape(m, k) if has_x2 else zmk,
+      du_out.reshape(m, k) if has_duo else zmk,
+      fill(scale, z1k), fill(shift, z1k), fill(scale2, z1k),
+      fill(shift2, z1k), fill(dssum, z1n), fill(dssq, z1n))
+    dx1, dx2, ds1, dt1, ds2, dt2, db = outs
+    return (dx1, dx2 if has_x2 else None,
+            ds1[0] if aff1 else None, dt1[0] if aff1 else None,
+            ds2[0] if aff2 else None, dt2[0] if aff2 else None, db[0])
+
+
+def _wgrad1x1_kernel(dy_ref, y_ref, x_ref, x2_ref, s1_ref, t1_ref,
+                     s2_ref, t2_ref, dsum_ref, dsq_ref, dw_ref,
+                     *, aff1, aff2, has_x2, relu, with_stats):
+    i = pl.program_id(0)
+    dyf = dy_ref[:].astype(jnp.float32)
+    if with_stats:
+        dyf = (dyf + dsum_ref[:]
+               + 2.0 * y_ref[:].astype(jnp.float32) * dsq_ref[:])
+    x = x_ref[:]
+    if aff1:
+        u = x * s1_ref[:].astype(x.dtype) + t1_ref[:].astype(x.dtype)
+    else:
+        u = x
+    if has_x2:
+        x2 = x2_ref[:]
+        if aff2:
+            u = u + (x2 * s2_ref[:].astype(x.dtype)
+                     + t2_ref[:].astype(x.dtype))
+        else:
+            u = u + x2
+    if relu:
+        u = jnp.maximum(u, 0)
+
+    @pl.when(i == 0)
+    def _():
+        dw_ref[:] = jnp.zeros_like(dw_ref)
+
+    dw_ref[:] += jax.lax.dot_general(
+        u, dyf.astype(u.dtype), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def wgrad_conv1x1(dy, y, x, x2=None, scale=None, shift=None, scale2=None,
+                  shift2=None, dssum=None, dssq=None, relu=False):
+    """Fused weight-gradient of fused_conv (1x1, stride 1): recomputes u
+    and ybar per tile, accumulates dW = u^T @ ybar in VMEM. Returns
+    dW [K, N] f32."""
+    m, n = dy.shape
+    k = x.reshape(m, -1).shape[1]
+    dtype = dy.dtype
+    mt = _pick_mt(m, max(k, n))
+    aff1 = scale is not None
+    aff2 = scale2 is not None
+    has_x2 = x2 is not None
+    with_stats = dssum is not None
+    grid = (m // mt,)
+    z1k = jnp.zeros((1, k), jnp.float32)
+    z1n = jnp.zeros((1, n), jnp.float32)
+    fill = lambda v, z: z if v is None else v.reshape(z.shape).astype(
+        jnp.float32)
+    zmk = jnp.zeros((1, k), dtype)
+    const = lambda *_: (0, 0)
+    row = lambda i: (i, 0)
+    rowk = pl.BlockSpec((mt, k), row, memory_space=pltpu.VMEM)
+    rown = pl.BlockSpec((mt, n), row, memory_space=pltpu.VMEM)
+    c1k = pl.BlockSpec((1, k), const, memory_space=pltpu.VMEM)
+    c1n = pl.BlockSpec((1, n), const, memory_space=pltpu.VMEM)
+    kernel = functools.partial(
+        _wgrad1x1_kernel, aff1=aff1, aff2=aff2, has_x2=has_x2, relu=relu,
+        with_stats=with_stats)
+    dw = pl.pallas_call(
+        kernel, grid=grid,
+        in_specs=[rown, rown, rowk, rowk if has_x2 else c1k,
+                  c1k, c1k, c1k, c1k, c1n, c1n],
+        out_specs=pl.BlockSpec((k, n), const, memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((k, n), jnp.float32),
+        interpret=_interpret(),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m * k * n,
+            bytes_accessed=(2 * m * n + (1 + has_x2) * m * k + k * n)
+            * dy.dtype.itemsize,
+            transcendentals=0),
+    )(dy, y, x.reshape(m, k),
+      x2.reshape(m, k) if has_x2 else zmk,
+      fill(scale, z1k), fill(shift, z1k), fill(scale2, z1k),
+      fill(shift2, z1k), fill(dssum, z1n), fill(dssq, z1n))
+    return dw
+
+
 # -------------------------------------------------------- reference impls
 
 

@@ -62,10 +62,9 @@ class ZooModel:
 
         c = self.conf()
         if self.helpers is not None:
-            if self.helpers not in ("none", "fused"):
-                raise ValueError(
-                    f"Unknown helper mode '{self.helpers}'. "
-                    "Known: none, fused")
+            from deeplearning4j_tpu.nn.helpers import validate_helper_mode
+
+            validate_helper_mode(self.helpers)
             if hasattr(c, "helper_mode"):
                 c.helper_mode = self.helpers
             else:

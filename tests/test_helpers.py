@@ -181,3 +181,51 @@ def test_gradcheck_fused_conv(rng, stride, relu, two_branch):
         np.testing.assert_allclose(np.asarray(a), np.asarray(e),
                                    rtol=2e-4, atol=2e-5,
                                    err_msg=f"arg {i}")
+
+
+def test_flat_train_chain_matches_per_layer_path(rng, monkeypatch):
+    """The grad-over-flat train step (updater/flat_chain.py) must produce
+    the same parameters as the per-layer fused_apply path, including when
+    the flat carry is interrupted by external params access."""
+    x, y = _data(rng)
+    net_flat = _mini_resnet("none", seed=11)
+    net_tree = _mini_resnet("none", seed=11)
+    # force the per-layer path on net_tree
+    net_tree._flat_chain = None
+    assert net_flat._flat_chain_obj() is not None
+
+    for i in range(3):
+        lf = float(net_flat.fit_batch(([x], [y])))
+        lt = float(net_tree.fit_batch(([x], [y])))
+        np.testing.assert_allclose(lf, lt, rtol=1e-5)
+        if i == 1:
+            # external access materializes the tree and drops the carry
+            _ = jax.tree_util.tree_leaves(net_flat.params)
+            assert net_flat._flat_train is None
+    pf = jax.tree_util.tree_leaves_with_path(net_flat.params)
+    pt = jax.tree_util.tree_leaves(net_tree.params)
+    for (path, a), b in zip(pf, pt):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=1e-6, err_msg=str(path))
+    uf = jax.tree_util.tree_leaves(net_flat.updater_states)
+    ut = jax.tree_util.tree_leaves(net_tree.updater_states)
+    for a, b in zip(uf, ut):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=1e-6)
+
+
+def test_flat_chain_ineligible_configs(rng):
+    """Per-layer learning rates / frozen layers / per-layer grad norms
+    fall back to the per-layer path."""
+    from deeplearning4j_tpu.nn.updater.flat_chain import FlatTrainChain
+
+    net = _mini_resnet("none")
+    assert FlatTrainChain.build(net) is not None
+    net.conf.gradient_normalization = "clip_l2_per_layer"
+    assert FlatTrainChain.build(net) is None
+    net.conf.gradient_normalization = None
+    net.topo[0].obj.frozen = True
+    try:
+        assert FlatTrainChain.build(net) is None
+    finally:
+        net.topo[0].obj.frozen = False
