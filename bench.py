@@ -177,12 +177,61 @@ def bench_lenet(batch=4096, iters=40):
     return batch * iters / dt, dt / iters, final_loss
 
 
+def bench_word2vec(vocab=5000, n_words=2_000_000, dim=128, window=5,
+                   k_neg=5, epochs=5):
+    """Secondary benchmark: Word2Vec skip-gram + negative sampling
+    (ref SkipGram.java:224 hot loop / native AggregateSkipGram role).
+    Dense tier: native single-pass epoch builder + slab-scan device
+    updates. Run with `python bench.py word2vec`."""
+    from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+
+    rng = np.random.default_rng(0)
+    p = 1.0 / np.arange(1, vocab + 1) ** 1.1
+    p /= p.sum()
+    words = np.array([f"w{i}" for i in range(vocab)])
+    corpus = rng.choice(vocab, size=n_words, p=p)
+    seqs = [list(words[corpus[i:i + 1000]])
+            for i in range(0, n_words, 1000)]
+    sv = SequenceVectors(layer_size=dim, window=window, negative=k_neg,
+                         epochs=1, seed=1, mode="dense")
+    sv.build_vocab(seqs)
+    sv.fit(seqs)          # warm: compiles the slab shapes
+    _ = sv.syn0           # materialize host copy (excluded d2h)
+    _ = sv.syn1neg
+    sv.epochs = epochs
+    t0 = time.perf_counter()
+    sv.fit(seqs)
+    # true barrier: a host scalar fetch (block_until_ready
+    # under-synchronizes through the dev tunnel, see PERF.md)
+    _ = float(np.asarray(sv._syn0_dev[0, 0]))
+    dt = time.perf_counter() - t0
+    # semantic sanity: frequent words should have coherent neighbors
+    sim = sv.similarity("w0", "w1")
+    assert np.isfinite(sim)
+    return n_words * epochs / dt, dt
+
+
 def main():
     import sys
 
     import jax
 
     dev = jax.devices()[0]
+    if len(sys.argv) > 1 and sys.argv[1] == "word2vec":
+        wps, dt = bench_word2vec()
+        print(json.dumps({
+            "metric": "word2vec_sgns_words_per_sec_per_chip",
+            "value": round(wps, 1),
+            "unit": "words/sec/chip",
+            "vs_baseline": 1.0,
+            "total_s": round(dt, 1),
+            "config": "vocab=5k zipf dim=128 window=5 K=5 "
+                      "5 epochs x 2M words, dense tier",
+            "device": str(dev.device_kind),
+            "platform": str(dev.platform),
+            "jax": jax.__version__,
+        }))
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "lenet":
         ips, step_s, loss = bench_lenet()
         base = BASELINES.get("lenet_mnist_train_images_per_sec")

@@ -26,6 +26,8 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
 _SRC_DIR = os.path.join(_REPO_ROOT, "native")
 _LIB_NAME = "libdl4j_tpu_native.so"
 
+_ABI_VERSION = 3
+
 _lock = threading.Lock()
 _lib = None
 _tried = False
@@ -65,8 +67,12 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
 
 
 def _bind(lib: ctypes.CDLL) -> Optional[ctypes.CDLL]:
-    if lib.dl4j_native_abi_version() != 1:
-        return None
+    if lib.dl4j_native_abi_version() != _ABI_VERSION:
+        # stale cached artifact: raise so _build_and_load's rebuild
+        # path (the AttributeError handler) removes and rebuilds it
+        raise AttributeError(
+            f"native ABI {lib.dl4j_native_abi_version()} != "
+            f"{_ABI_VERSION}")
     lib.dl4j_parse_csv_f32.restype = ctypes.c_int
     lib.dl4j_parse_csv_f32.argtypes = [
         ctypes.c_char_p, ctypes.c_int64, ctypes.c_char,
@@ -81,6 +87,14 @@ def _bind(lib: ctypes.CDLL) -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float),
         ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
         ctypes.c_float, ctypes.c_float]
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    for fn in (lib.dl4j_w2v_sg_pack, lib.dl4j_w2v_cbow_pack):
+        fn.restype = ctypes.c_int64
+        fn.argtypes = [i32p, i32p, ctypes.c_int64, ctypes.c_int64,
+                       ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+                       f32p, i32p, ctypes.c_int64, ctypes.c_uint64,
+                       i32p]
     return lib
 
 
@@ -178,3 +192,57 @@ def chw_u8_to_hwc_f32(src: np.ndarray, scale: float = 1.0 / 255.0,
         dst.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         n, c, h, w, scale, shift)
     return dst
+
+
+def _w2v_pack(fn_name, corpus, sid, window, k_neg, alias_prob,
+              alias_idx, seed, p0=0, p1=None):
+    lib = _get()
+    if lib is None:
+        return None
+    corpus = np.ascontiguousarray(corpus, np.int32)
+    sid = np.ascontiguousarray(sid, np.int32)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    if k_neg > 0:
+        alias_prob = np.ascontiguousarray(alias_prob, np.float32)
+        alias_idx = np.ascontiguousarray(alias_idx, np.int32)
+        vocab = alias_prob.size
+        ap = alias_prob.ctypes.data_as(f32p)
+        ai = alias_idx.ctypes.data_as(i32p)
+    else:
+        vocab = 0
+        ap = f32p()
+        ai = i32p()
+    fn = getattr(lib, fn_name)
+    n = corpus.size
+    if p1 is None:
+        p1 = n
+    count = fn(corpus.ctypes.data_as(i32p), sid.ctypes.data_as(i32p),
+               n, p0, p1, window, k_neg, ap, ai, vocab, seed, i32p())
+    cols = ((2 + k_neg) if fn_name == "dl4j_w2v_sg_pack"
+            else (2 * window + 1 + k_neg))
+    out = np.empty((count, cols), np.int32)
+    if count:
+        fn(corpus.ctypes.data_as(i32p), sid.ctypes.data_as(i32p),
+           n, p0, p1, window, k_neg, ap, ai, vocab, seed,
+           out.ctypes.data_as(i32p))
+    return out
+
+
+def w2v_sg_pack(corpus, sid, window, k_neg, alias_prob, alias_idx,
+                seed, p0=0, p1=None) -> Optional[np.ndarray]:
+    """Skip-gram epoch rows [center, positive, K negatives] in corpus
+    order (reduced-window + alias negative sampling fused in one native
+    pass); centers restricted to positions [p0, p1) so chunked callers
+    can overlap windows. Returns None when the native library is
+    unavailable."""
+    return _w2v_pack("dl4j_w2v_sg_pack", corpus, sid, window, k_neg,
+                     alias_prob, alias_idx, seed, p0, p1)
+
+
+def w2v_cbow_pack(corpus, sid, window, k_neg, alias_prob, alias_idx,
+                  seed, p0=0, p1=None) -> Optional[np.ndarray]:
+    """CBOW epoch rows [2W context (-1 pad), center, K negatives];
+    centers restricted to [p0, p1)."""
+    return _w2v_pack("dl4j_w2v_cbow_pack", corpus, sid, window, k_neg,
+                     alias_prob, alias_idx, seed, p0, p1)

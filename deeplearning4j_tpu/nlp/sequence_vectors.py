@@ -4,10 +4,18 @@ fit :187, worker loop :289; elements-learning algorithms SkipGram.java:31
 (iterateSample :224, HS :238, negative sampling :258) and CBOW.java).
 
 TPU-native redesign: the reference trains with multithreaded hogwild over
-a shared host table. Here, window extraction + negative sampling happen
-on host (numpy), and the math runs as jit-compiled batched steps with
-scatter-add updates — the same per-pair SGD update, applied batch-
-synchronously, MXU-friendly (batched [B,D] x [B,K,D] einsums).
+a shared host table. Here the tables live in HBM and train with
+jit-compiled batched scatter-add updates, in one of two tiers:
+
+- scan tier (small vocab, default < 2048): lax.scan over small chunks
+  approximates the reference's sequential per-pair SGD — in-batch
+  duplicate updates would collapse tiny vocabularies otherwise.
+- dense tier (large vocab / mode='dense'): the native single-pass epoch
+  builder (native/dl4j_tpu_native.cpp, the AggregateSkipGram role)
+  packs [center, positive, K alias-sampled negatives] rows in corpus
+  order; fixed-shape slabs of batches upload once and train in a single
+  lax.scan dispatch of pure gather->VPU->scatter updates. See
+  _DenseSteps for the measured design rationale.
 """
 
 from __future__ import annotations
@@ -258,8 +266,261 @@ class _CbowHierarchicSoftmaxStep:
                         mask, lr)
 
 
+class _DenseSteps:
+    """Dense batched updates for large vocabularies (SURVEY §7 step 9 —
+    the role of the reference's native AggregateSkipGram op behind
+    SkipGram.java:224's hot loop, redesigned for the TPU).
+
+    Differences from the scan tier above, chosen for throughput:
+
+    - One batched update per batch of B pairs — in-batch duplicates sum
+      their gradients at the same table values (i.e. plain minibatch
+      SGD) instead of chunk-sequential semantics. At large vocab the
+      duplicate rate is negligible; at small vocab the scan tier remains
+      the default (see SequenceVectors._ensure_steps).
+    - The device step is pure gather -> VPU elementwise -> scatter-add:
+      logits/grads are broadcast-multiply-reduce, NOT batched dot_general
+      (a [B]-batched [1,D]x[D,K] dot pads each tiny matmul to an MXU
+      tile and loses ~an order of magnitude).
+    - Negative sampling happens on HOST (native single-pass alias
+      builder; see native/dl4j_tpu_native.cpp dl4j_w2v_sg_pack).
+      Profiling showed both jnp.searchsorted and per-scalar alias-table
+      gathers lower to multi-millisecond loops on TPU.
+    - A whole SLAB of batches ships as one [nb, B, cols] int32 upload
+      and trains in one dispatch (lax.scan over batches): per-batch h2d
+      transfers starved the device through the tunnel, and the scan's
+      xs double-buffering hides the slice loads.
+    - Negatives that collide with the row's positive have their gradient
+      masked on device (same effect as the reference's resample loop:
+      no contradictory label on one index).
+    - Tables are donated buffers: the update aliases in place, and the
+      host never fetches until the lazy table properties are read.
+    """
+
+    def __init__(self, negative: int = 5):
+        self.negative = negative
+        self._sg_ns = None
+        self._sg_hs = None
+        self._cbow_ns = None
+        self._cbow_hs = None
+
+    @staticmethod
+    def _sg_ns_body(syn0, syn1neg, pack, lr):
+        """pack [B, K+2] int32: col 0 center, col 1 positive, rest
+        negatives."""
+        import jax
+        import jax.numpy as jnp
+
+        cen = pack[:, 0]
+        tgt = pack[:, 1:]
+        B, K1 = tgt.shape
+        D = syn0.shape[1]
+        lab = jnp.zeros((B, K1)).at[:, 0].set(1.0)
+        ok = jnp.concatenate(
+            [jnp.ones((B, 1), bool), tgt[:, 1:] != tgt[:, :1]], axis=1)
+        v = syn0[cen]                        # [B,D]
+        u = syn1neg[tgt]                     # [B,K+1,D]
+        p = jax.nn.sigmoid(jnp.sum(v[:, None, :] * u, axis=-1))
+        g = jnp.where(ok, (lab - p) * lr, 0.0)
+        dv = jnp.sum(g[:, :, None] * u, axis=1)
+        du = (g[:, :, None] * v[:, None, :]).reshape(-1, D)
+        syn0 = syn0.at[cen].add(dv)
+        syn1neg = syn1neg.at[tgt.reshape(-1)].add(du)
+        return syn0, syn1neg
+
+    @staticmethod
+    def _sg_hs_body(syn0, syn1, pts_tab, cds_tab, msk_tab, pack, lr):
+        """pack [B, 2] int32: col 0 center, col 1 positive."""
+        import jax
+        import jax.numpy as jnp
+
+        cen, pos = pack[:, 0], pack[:, 1]
+        D = syn0.shape[1]
+        pts, cds, msk = pts_tab[pos], cds_tab[pos], msk_tab[pos]
+        v = syn0[cen]                        # [B,D]
+        u = syn1[pts]                        # [B,L,D]
+        p = jax.nn.sigmoid(jnp.sum(v[:, None, :] * u, axis=-1))
+        g = ((1.0 - cds) - p) * msk * lr
+        dv = jnp.sum(g[:, :, None] * u, axis=1)
+        du = (g[:, :, None] * v[:, None, :]).reshape(-1, D)
+        syn0 = syn0.at[cen].add(dv)
+        syn1 = syn1.at[pts.reshape(-1)].add(du)
+        return syn0, syn1
+
+    @staticmethod
+    def _cbow_ns_body(syn0, syn1neg, pack, W, lr):
+        """pack [B, W+K+1] int32: cols 0..W-1 context (-1 = empty
+        slot), col W center/positive, rest negatives."""
+        import jax
+        import jax.numpy as jnp
+
+        cw_raw = pack[:, :W]
+        cm = (cw_raw >= 0).astype(jnp.float32)
+        cw = jnp.maximum(cw_raw, 0)
+        tgt = pack[:, W:]
+        B, K1 = tgt.shape
+        D = syn0.shape[1]
+        lab = jnp.zeros((B, K1)).at[:, 0].set(1.0)
+        ok = jnp.concatenate(
+            [jnp.ones((B, 1), bool), tgt[:, 1:] != tgt[:, :1]], axis=1)
+        counts = jnp.maximum(jnp.sum(cm, axis=1), 1.0)
+        ctx_v = syn0[cw]                     # [B,W,D]
+        h = (jnp.sum(ctx_v * cm[:, :, None], axis=1)
+             / counts[:, None])              # [B,D]
+        u = syn1neg[tgt]                     # [B,K+1,D]
+        p = jax.nn.sigmoid(jnp.sum(h[:, None, :] * u, axis=-1))
+        g = jnp.where(ok, (lab - p) * lr, 0.0)
+        du = (g[:, :, None] * h[:, None, :]).reshape(-1, D)
+        dh = jnp.sum(g[:, :, None] * u, axis=1)
+        syn1neg = syn1neg.at[tgt.reshape(-1)].add(du)
+        dctx = dh[:, None, :] * cm[:, :, None]
+        syn0 = syn0.at[cw.reshape(-1)].add(dctx.reshape(-1, D))
+        return syn0, syn1neg
+
+    @staticmethod
+    def _cbow_hs_body(syn0, syn1, pts_tab, cds_tab, msk_tab, pack, W,
+                      lr):
+        """pack [B, W+1] int32: cols 0..W-1 context (-1 = empty), col W
+        center."""
+        import jax
+        import jax.numpy as jnp
+
+        cw_raw = pack[:, :W]
+        cm = (cw_raw >= 0).astype(jnp.float32)
+        cw = jnp.maximum(cw_raw, 0)
+        cen = pack[:, W]
+        D = syn0.shape[1]
+        pts, cds, msk = pts_tab[cen], cds_tab[cen], msk_tab[cen]
+        counts = jnp.maximum(jnp.sum(cm, axis=1), 1.0)
+        ctx_v = syn0[cw]
+        h = (jnp.sum(ctx_v * cm[:, :, None], axis=1)
+             / counts[:, None])
+        u = syn1[pts]                        # [B,L,D]
+        p = jax.nn.sigmoid(jnp.sum(h[:, None, :] * u, axis=-1))
+        g = ((1.0 - cds) - p) * msk * lr
+        du = (g[:, :, None] * h[:, None, :]).reshape(-1, D)
+        dh = jnp.sum(g[:, :, None] * u, axis=1)
+        syn1 = syn1.at[pts.reshape(-1)].add(du)
+        dctx = dh[:, None, :] * cm[:, :, None]
+        syn0 = syn0.at[cw.reshape(-1)].add(dctx.reshape(-1, D))
+        return syn0, syn1
+
+    # --------------------------------------------------- slab dispatch
+    def sg_ns(self, syn0, syn1neg, packs, lrs):
+        """packs [nb, B, K+2] int32, lrs [nb] f32: one dispatch trains
+        the whole slab via lax.scan."""
+        import jax
+
+        if self._sg_ns is None:
+            body = self._sg_ns_body
+
+            def slab(syn0, syn1neg, packs, lrs):
+                def step(carry, xs):
+                    return body(*carry, *xs), None
+                (syn0, syn1neg), _ = jax.lax.scan(
+                    step, (syn0, syn1neg), (packs, lrs))
+                return syn0, syn1neg
+
+            self._sg_ns = jax.jit(slab, donate_argnums=(0, 1))
+        return self._sg_ns(syn0, syn1neg, packs, lrs)
+
+    def sg_hs(self, syn0, syn1, pts_tab, cds_tab, msk_tab, packs, lrs):
+        import jax
+
+        if self._sg_hs is None:
+            body = self._sg_hs_body
+
+            def slab(syn0, syn1, pts_tab, cds_tab, msk_tab, packs, lrs):
+                def step(carry, xs):
+                    return body(*carry, pts_tab, cds_tab, msk_tab,
+                                *xs), None
+                (syn0, syn1), _ = jax.lax.scan(
+                    step, (syn0, syn1), (packs, lrs))
+                return syn0, syn1
+
+            self._sg_hs = jax.jit(slab, donate_argnums=(0, 1))
+        return self._sg_hs(syn0, syn1, pts_tab, cds_tab, msk_tab, packs,
+                           lrs)
+
+    def cbow_ns(self, syn0, syn1neg, packs, W, lrs):
+        import jax
+
+        if self._cbow_ns is None:
+            body = self._cbow_ns_body
+
+            def slab(syn0, syn1neg, packs, lrs):
+                def step(carry, xs):
+                    pack, lr = xs
+                    return body(*carry, pack, W, lr), None
+                (syn0, syn1neg), _ = jax.lax.scan(
+                    step, (syn0, syn1neg), (packs, lrs))
+                return syn0, syn1neg
+
+            self._cbow_ns = jax.jit(slab, donate_argnums=(0, 1))
+        return self._cbow_ns(syn0, syn1neg, packs, lrs)
+
+    def cbow_hs(self, syn0, syn1, pts_tab, cds_tab, msk_tab, packs, W,
+                lrs):
+        import jax
+
+        if self._cbow_hs is None:
+            body = self._cbow_hs_body
+
+            def slab(syn0, syn1, pts_tab, cds_tab, msk_tab, packs, lrs):
+                def step(carry, xs):
+                    pack, lr = xs
+                    return body(*carry, pts_tab, cds_tab, msk_tab, pack,
+                                W, lr), None
+                (syn0, syn1), _ = jax.lax.scan(
+                    step, (syn0, syn1), (packs, lrs))
+                return syn0, syn1
+
+            self._cbow_hs = jax.jit(slab, donate_argnums=(0, 1))
+        return self._cbow_hs(syn0, syn1, pts_tab, cds_tab, msk_tab,
+                             packs, lrs)
+
+
 class SequenceVectors:
-    """Generic embedding trainer over token sequences."""
+    """Generic embedding trainer over token sequences.
+
+    The syn0/syn1/syn1neg tables are lazily-fetched properties: after a
+    dense fit they stay device-resident (HBM) and only materialize to
+    numpy when read — queries and serialization trigger one transfer.
+    """
+
+    @staticmethod
+    def _lazy(host, dev):
+        if host is None and dev is not None:
+            host = np.asarray(dev)
+        return host
+
+    @property
+    def syn0(self):
+        self._syn0_host = self._lazy(self._syn0_host, self._syn0_dev)
+        return self._syn0_host
+
+    @syn0.setter
+    def syn0(self, v):
+        self._syn0_host, self._syn0_dev = v, None
+
+    @property
+    def syn1(self):
+        self._syn1_host = self._lazy(self._syn1_host, self._syn1_dev)
+        return self._syn1_host
+
+    @syn1.setter
+    def syn1(self, v):
+        self._syn1_host, self._syn1_dev = v, None
+
+    @property
+    def syn1neg(self):
+        self._syn1neg_host = self._lazy(self._syn1neg_host,
+                                        self._syn1neg_dev)
+        return self._syn1neg_host
+
+    @syn1neg.setter
+    def syn1neg(self, v):
+        self._syn1neg_host, self._syn1neg_dev = v, None
 
     def __init__(self, layer_size: int = 100, window: int = 5,
                  negative: int = 5, use_hierarchic_softmax: bool = False,
@@ -267,7 +528,9 @@ class SequenceVectors:
                  min_learning_rate: float = 1e-4, epochs: int = 1,
                  batch_size: int = 512, sampling: float = 0.0,
                  use_cbow: bool = False, seed: int = 42,
-                 chunk: Optional[int] = None):
+                 chunk: Optional[int] = None,
+                 mode: Optional[str] = None,
+                 dense_batch_size: int = 32768):
         self.layer_size = layer_size
         self.window = window
         self.negative = negative
@@ -281,9 +544,9 @@ class SequenceVectors:
         self.seed = seed
 
         self.vocab = AbstractCache(min_word_frequency)
-        self.syn0: Optional[np.ndarray] = None
-        self.syn1: Optional[np.ndarray] = None      # HS inner nodes
-        self.syn1neg: Optional[np.ndarray] = None
+        self.syn0 = None
+        self.syn1 = None      # HS inner nodes
+        self.syn1neg = None
         self._unigram: Optional[np.ndarray] = None
         self._max_code_len = 0
         # One chunk constant shared by all jit steps; batch_size is
@@ -305,14 +568,33 @@ class SequenceVectors:
         self._hs_step = None
         self._cbow_neg_step = None
         self._cbow_hs_step = None
+        # mode: None = auto (dense when the vocab is large enough that
+        # in-batch duplicate updates are noise, scan otherwise);
+        # 'scan' / 'dense' force a tier. An explicit chunk implies scan.
+        if mode not in (None, "scan", "dense"):
+            raise ValueError(f"mode must be None|'scan'|'dense': {mode}")
+        self._mode = mode
+        self.dense_batch_size = int(dense_batch_size)
+        self._dense = False
+        self._dense_steps = None
+        self._hs_tables = None
 
     def _ensure_steps(self):
-        if self._neg_step is not None:
+        if self._neg_step is not None or self._dense_steps is not None:
+            return
+        V = self.vocab.num_words()
+        if self._mode == "dense":
+            self._dense = True
+        elif self._mode == "scan" or self._chunk_param is not None:
+            self._dense = False
+        else:
+            self._dense = V >= 2048
+        if self._dense:
+            self._dense_steps = _DenseSteps(negative=self.negative)
             return
         if self._chunk_param is not None:
             self._chunk = int(self._chunk_param)
         else:
-            V = self.vocab.num_words()
             self._chunk = 32 if V < 2048 else 512
         self.batch_size = (-(-self._raw_batch_size // self._chunk)
                            * self._chunk)
@@ -394,12 +676,332 @@ class SequenceVectors:
                 if ctx:
                     yield center, ctx
 
+    # ------------------------------------------------- dense host side
+    def _index_corpus(self, seqs) -> List[np.ndarray]:
+        """Translate token sequences to vocab-index arrays once (reused
+        across epochs; only subsampling/windows are re-drawn)."""
+        out = []
+        for seq in seqs:
+            idxs = [self.vocab.index_of(t) for t in seq]
+            arr = np.asarray([i for i in idxs if i >= 0], np.int32)
+            if arr.size:
+                out.append(arr)
+        return out
+
+    def _subsample_flat(self, idx_arrays, rng):
+        """Concatenate the corpus with per-sequence ids, applying the
+        subsampling keep-test vectorized (same formula as
+        _sequence_indices)."""
+        arr = np.concatenate(idx_arrays)
+        sid = np.concatenate([np.full(a.size, i, np.int32)
+                              for i, a in enumerate(idx_arrays)])
+        if self.sampling > 0 and self.vocab.total_word_count > 0:
+            counts = self.vocab.counts().astype(np.float64)
+            f = counts / counts.sum()
+            with np.errstate(divide="ignore", invalid="ignore"):
+                keep_p = np.minimum(
+                    1.0, (np.sqrt(f / self.sampling) + 1)
+                    * self.sampling / np.maximum(f, 1e-300))
+            m = rng.random(arr.size) < keep_p[arr]
+            arr, sid = arr[m], sid[m]
+        return arr, sid
+
+    def _context_slots(self, arr, sid, rng, p0, p1):
+        """[-1-padded] context-candidate matrix for centers [p0, p1) of
+        the full epoch stream: rows see neighbors across the chunk edge
+        because `arr`/`sid` are the whole arrays. Shared by both numpy
+        fallbacks."""
+        n = arr.size
+        W2 = 2 * self.window
+        p1 = min(p1, n)
+        m = p1 - p0
+        if m <= 0:
+            return np.zeros((0, W2), np.int32), arr[:0]
+        b = rng.integers(1, self.window + 1, size=m)
+        pos = np.arange(p0, p1)
+        cand = np.full((m, W2), -1, np.int32)
+        slot = 0
+        for off in range(-self.window, self.window + 1):
+            if off == 0:
+                continue
+            j = pos + off
+            jc = np.clip(j, 0, n - 1)
+            valid = ((j >= 0) & (j < n) & (abs(off) <= b)
+                     & (sid[jc] == sid[pos]))
+            cand[:, slot] = np.where(valid, arr[jc], -1)
+            slot += 1
+        return cand, arr[p0:p1]
+
+    def _pairs_from_flat(self, arr, sid, rng, p0=0, p1=None):
+        """NumPy fallback for the native sg builder: (center, context)
+        skip-gram pairs for centers [p0, p1) with the reduced-window
+        trick, vectorized one pass per window offset and emitted in
+        CORPUS ORDER (position-major) — the same streaming order the
+        reference trains in (SequenceVectors.java:289), so the linear
+        lr decay sees the corpus the same way and no O(P log P) shuffle
+        is paid."""
+        if p1 is None:
+            p1 = arr.size
+        cand, centers = self._context_slots(arr, sid, rng, p0, p1)
+        if centers.size == 0:
+            return (np.zeros(0, np.int32),) * 2
+        flat = cand.ravel()
+        m = flat >= 0
+        c = np.repeat(centers, cand.shape[1])[m]
+        x = flat[m]
+        return c, x
+
+    def _cbow_from_flat(self, arr, sid, rng, p0=0, p1=None):
+        """NumPy fallback for the native cbow builder: one example per
+        position [p0, p1) in corpus order, fixed-width [N, 2*window]
+        context with -1 marking empty slots."""
+        if p1 is None:
+            p1 = arr.size
+        cw, centers = self._context_slots(arr, sid, rng, p0, p1)
+        keep = (cw >= 0).any(axis=1)
+        return cw[keep], centers[keep]
+
+    def _hs_device_tables(self):
+        """[V, L] Huffman (points, codes, mask) tables for device-side
+        gather (built once; the scan tier packs per-batch on host)."""
+        if self._hs_tables is None:
+            V = self.vocab.num_words()
+            L = max(self._max_code_len, 1)
+            words = self.vocab.vocab_words()
+            pts = np.zeros((V, L), np.int32)
+            cds = np.zeros((V, L), np.float32)
+            msk = np.zeros((V, L), np.float32)
+            for i in range(V):
+                w = words[i]
+                l = len(w.codes)
+                pts[i, :l] = w.points
+                cds[i, :l] = w.codes
+                msk[i, :l] = 1.0
+            self._hs_tables = (pts, cds, msk)
+        return self._hs_tables
+
+    def _alias_tables(self):
+        """Vose alias tables for the unigram^0.75 negative distribution.
+        Sampling = two uniform draws + two table lookups, all vectorized
+        on host (np.searchsorted over the CDF costs ~log V per draw and
+        profiles ~8x slower at word2vec batch sizes)."""
+        if getattr(self, "_alias", None) is None:
+            p = self._unigram
+            V = p.size
+            prob = np.zeros(V)
+            alias = np.zeros(V, np.int32)
+            scaled = (p * V).astype(np.float64).copy()
+            small = [i for i in range(V) if scaled[i] < 1.0]
+            large = [i for i in range(V) if scaled[i] >= 1.0]
+            while small and large:
+                s, l = small.pop(), large.pop()
+                prob[s] = scaled[s]
+                alias[s] = l
+                scaled[l] -= 1.0 - scaled[s]
+                (small if scaled[l] < 1.0 else large).append(l)
+            for i in small + large:
+                prob[i] = 1.0
+            self._alias = (prob.astype(np.float32), alias)
+        return self._alias
+
+    def _host_negatives(self, rng, positives):
+        """[B, K+1] targets (positive first) via the alias method.
+        Collisions with the positive are handled by a gradient mask on
+        device (see _DenseSteps)."""
+        B = positives.size
+        K = self.negative
+        prob, alias = self._alias_tables()
+        # one f32 uniform per draw: the integer part picks the bucket,
+        # the fractional remainder (still uniform given the bucket)
+        # runs the alias coin-flip — one RNG pass for the hot path.
+        # f32 resolution bounds the vocab at 2^24; larger vocabularies
+        # get f64 draws.
+        dt = np.float32 if prob.size < (1 << 24) else np.float64
+        r = rng.random((B, K), dtype=dt) * prob.size
+        u1 = r.astype(np.int32)
+        neg = np.where(r - u1 < prob[u1], u1, alias[u1])
+        return np.concatenate(
+            [positives.astype(np.int32)[:, None], neg], axis=1)
+
+    # Slab size: batches per dispatch. One compiled scan shape per
+    # model — epoch tails are neutralized with lr=0 batches rather than
+    # a second compile. 64 * 32768 * 7 ints ~ 59 MB device-resident.
+    _DENSE_SLAB = 64
+
+    def _epoch_pack_chunk(self, arr, sid, rng, p0, p1):
+        """Packed rows for centers in positions [p0, p1) of the full
+        epoch stream (native builder with numpy fallback) — windows see
+        across chunk boundaries because the whole arrays are passed."""
+        from deeplearning4j_tpu import native
+
+        K = self.negative if self.negative > 0 else 0
+        if K:
+            prob, alias = self._alias_tables()
+        else:
+            prob = alias = None
+        seed = int(rng.integers(0, 2 ** 63))
+        fn = (native.w2v_cbow_pack if self.use_cbow
+              else native.w2v_sg_pack)
+        pk = fn(arr, sid, self.window, K, prob, alias, seed, p0, p1)
+        if pk is not None:
+            return pk
+        if self.use_cbow:
+            cw, cen = self._cbow_from_flat(arr, sid, rng, p0, p1)
+            parts = [cw, cen[:, None].astype(np.int32)]
+            if K:
+                parts.append(self._host_negatives(rng, cen)[:, 1:])
+            return np.concatenate(parts, axis=1)
+        cen, ctx = self._pairs_from_flat(arr, sid, rng, p0, p1)
+        if K:
+            return np.concatenate(
+                [cen[:, None].astype(np.int32),
+                 self._host_negatives(rng, ctx)], axis=1)
+        return np.stack([cen, ctx], axis=1).astype(np.int32)
+
+    def _dispatch_slab(self, tables, rows, lrs, W, hs_tabs):
+        """Ship one [S*Bp, cols] row block + per-batch lrs and run the
+        scan-slab step(s). Returns updated tables."""
+        import jax.numpy as jnp
+
+        syn0, syn1, syn1neg = tables
+        S = lrs.size
+        Bp = rows.shape[0] // S
+        cols = rows.shape[1]
+        lrs_d = jnp.asarray(lrs)
+        if self.use_cbow:
+            if self.use_hs:
+                packs = jnp.asarray(np.ascontiguousarray(
+                    rows[:, :W + 1]).reshape(S, Bp, W + 1))
+                syn0, syn1 = self._dense_steps.cbow_hs(
+                    syn0, syn1, *hs_tabs, packs, W, lrs_d)
+            if self.negative > 0:
+                packs = jnp.asarray(rows.reshape(S, Bp, cols))
+                syn0, syn1neg = self._dense_steps.cbow_ns(
+                    syn0, syn1neg, packs, W, lrs_d)
+        else:
+            if self.use_hs:
+                packs = jnp.asarray(np.ascontiguousarray(
+                    rows[:, :2]).reshape(S, Bp, 2))
+                syn0, syn1 = self._dense_steps.sg_hs(
+                    syn0, syn1, *hs_tabs, packs, lrs_d)
+            if self.negative > 0:
+                packs = jnp.asarray(rows.reshape(S, Bp, cols))
+                syn0, syn1neg = self._dense_steps.sg_ns(
+                    syn0, syn1neg, packs, lrs_d)
+        return syn0, syn1, syn1neg
+
+    def _fit_dense(self, seqs):
+        """Streamed dense training: the corpus is processed in
+        position-chunks whose packed rows accumulate in a host buffer;
+        every full slab (fixed [S, Bp, cols] shape, ONE compile) ships
+        as a single scan dispatch, so chunk building overlaps device
+        compute. The epoch tail pads to the slab shape with wrap-around
+        rows; fully-padded batches get lr=0 (no update) instead of a
+        second compiled shape."""
+        import jax.numpy as jnp
+
+        idx_arrays = self._index_corpus(seqs)
+        if not idx_arrays:
+            return self
+        rng = np.random.default_rng(self.seed + 1)
+        W = 2 * self.window
+
+        def take_dev(host_attr, dev_attr):
+            """Device-resident table if present (ownership transferred:
+            the jit steps donate it), else upload the host copy."""
+            dev = getattr(self, dev_attr)
+            if dev is not None:
+                setattr(self, dev_attr, None)
+                return dev
+            host = getattr(self, host_attr)
+            return None if host is None else jnp.asarray(host)
+
+        tables = (take_dev("_syn0_host", "_syn0_dev"),
+                  take_dev("_syn1_host", "_syn1_dev"),
+                  take_dev("_syn1neg_host", "_syn1neg_dev"))
+        hs_tabs = None
+        if self.use_hs:
+            pts, cds, msk = self._hs_device_tables()
+            hs_tabs = (jnp.asarray(pts), jnp.asarray(cds),
+                       jnp.asarray(msk))
+        per_pos = 1 if self.use_cbow else self.window
+        approx = max(1, sum(a.size for a in idx_arrays) * per_pos
+                     * self.epochs)
+        S = self._DENSE_SLAB
+        seen = 0
+        for _ in range(self.epochs):
+            arr, sid = self._subsample_flat(idx_arrays, rng)
+            n = arr.size
+            if n == 0:
+                continue
+            Bp = self.dense_batch_size
+            slab_rows = S * Bp
+            # chunk sized to produce ~1.25 slabs of rows so the buffer
+            # drains about once per chunk
+            pos_chunk = max(1, int(slab_rows * 1.25 / max(per_pos, 1)))
+            buf: list = []
+            buffered = 0
+            first_rows = None
+            for a in range(0, n, pos_chunk):
+                pk = self._epoch_pack_chunk(
+                    arr, sid, rng, a, min(a + pos_chunk, n))
+                if first_rows is None and pk.shape[0]:
+                    first_rows = pk[:Bp].copy()
+                buf.append(pk)
+                buffered += pk.shape[0]
+                while buffered >= slab_rows:
+                    block = np.concatenate(buf, axis=0)
+                    rows, rest = block[:slab_rows], block[slab_rows:]
+                    buf, buffered = [rest], rest.shape[0]
+                    lrs = np.asarray(
+                        [self._lr(seen + i * Bp, approx)
+                         for i in range(S)], np.float32)
+                    tables = self._dispatch_slab(
+                        tables, rows, lrs, W, hs_tabs)
+                    seen += slab_rows
+            # epoch tail: top up to the fixed slab shape; whole pad
+            # batches get lr=0, the boundary batch wraps epoch-head rows
+            rest = (np.concatenate(buf, axis=0) if buf
+                    else np.zeros((0, 2), np.int32))
+            if rest.shape[0]:
+                n_real = rest.shape[0]
+                nb_real = -(-n_real // Bp)
+                pad_src = first_rows if first_rows is not None else rest
+                need = nb_real * Bp - n_real
+                reps = -(-need // max(pad_src.shape[0], 1)) if need else 0
+                pad = (np.concatenate([pad_src] * reps, axis=0)[:need]
+                       if reps else rest[:0])
+                filler = np.zeros(
+                    ((S - nb_real) * Bp, rest.shape[1]), np.int32)
+                rows = np.concatenate([rest, pad, filler], axis=0)
+                lrs = np.asarray(
+                    [self._lr(seen + i * Bp, approx) if i < nb_real
+                     else 0.0 for i in range(S)], np.float32)
+                tables = self._dispatch_slab(
+                    tables, rows, lrs, W, hs_tabs)
+                seen += n_real
+        syn0, syn1, syn1neg = tables
+        # Leave the tables device-resident: queries (similarity/
+        # words_nearest) and serialization fetch lazily through the
+        # syn0/syn1/syn1neg properties. Through the dev tunnel a d2h
+        # fetch of the tables costs seconds; in production it is one
+        # DMA — either way fit() should not pay it eagerly.
+        self._syn0_host = None
+        self._syn0_dev = syn0
+        if syn1 is not None:
+            self._syn1_host, self._syn1_dev = None, syn1
+        if syn1neg is not None:
+            self._syn1neg_host, self._syn1neg_dev = None, syn1neg
+        return self
+
     # ------------------------------------------------------------- fit
     def fit(self, sequences: Iterable[Sequence[str]]):
         seqs = [list(s) for s in sequences]
-        if self.syn0 is None:
+        if self._syn0_host is None and self._syn0_dev is None:
             self.build_vocab(seqs)
         self._ensure_steps()
+        if self._dense:
+            return self._fit_dense(seqs)
         import jax.numpy as jnp
 
         rng = np.random.default_rng(self.seed + 1)

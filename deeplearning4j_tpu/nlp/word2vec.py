@@ -55,6 +55,13 @@ class Word2Vec(SequenceVectors):
             self._kw["use_cbow"] = bool(v)
             return self
 
+        def mode(self, v):
+            """Training tier: None (auto), 'scan' (sequential-fidelity
+            chunked updates) or 'dense' (native epoch builder +
+            slab-scan device updates; the high-throughput path)."""
+            self._kw["mode"] = v
+            return self
+
         def min_word_frequency(self, v):
             self._kw["min_word_frequency"] = int(v)
             return self

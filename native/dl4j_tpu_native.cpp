@@ -85,6 +85,132 @@ void dl4j_chw_u8_to_hwc_f32(const uint8_t* src, float* dst,
     }
 }
 
-int dl4j_native_abi_version() { return 1; }
+// ---------------------------------------------------------------------
+// Word2Vec epoch builders (parity role: the reference's native
+// AggregateSkipGram/CBOW ops behind SkipGram.java:224 — here the
+// DEVICE does the math, so the native hot path is the host-side
+// example assembly: window extraction + alias-method negative
+// sampling, fused into one pass that writes the packed int32 batch
+// rows the jit step consumes directly. The numpy pipeline needs ~6
+// full-array temporaries per window offset; this is one stream.)
+
+// splitmix64: per-position deterministic stream so a separate count
+// pass and fill pass see identical draws.
+static inline uint64_t dl4j_sm64(uint64_t* s) {
+    uint64_t z = (*s += 0x9E3779B97F4A7C15ULL);
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+    return z ^ (z >> 31);
+}
+
+static inline float dl4j_u01(uint64_t* s) {
+    return static_cast<float>(dl4j_sm64(s) >> 40)
+        * (1.0f / 16777216.0f);
+}
+
+static inline int32_t dl4j_alias_draw(uint64_t* s, const float* prob,
+                                      const int32_t* alias,
+                                      int64_t vocab) {
+    float r = dl4j_u01(s) * static_cast<float>(vocab);
+    int64_t u1 = static_cast<int64_t>(r);
+    if (u1 >= vocab) u1 = vocab - 1;
+    float frac = r - static_cast<float>(u1);
+    return frac < prob[u1] ? static_cast<int32_t>(u1) : alias[u1];
+}
+
+// Skip-gram epoch pack: rows of [center, positive, K negatives] in
+// corpus (position-major) order with the reduced-window trick.
+// out == NULL: count-only pass, returns the number of rows.
+// Rows are emitted only for centers in [p0, p1) — callers stream the
+// corpus in chunks extended by `window` on each side so windows are
+// never truncated at chunk boundaries.
+int64_t dl4j_w2v_sg_pack(const int32_t* corpus, const int32_t* sid,
+                         int64_t n, int64_t p0, int64_t p1,
+                         int window, int k_neg,
+                         const float* alias_prob,
+                         const int32_t* alias_idx, int64_t vocab,
+                         uint64_t seed, int32_t* out) {
+    int64_t rows = 0;
+    const int cols = 2 + k_neg;
+    if (p1 > n) p1 = n;
+    for (int64_t p = p0; p < p1; ++p) {
+        // two per-position streams: `s` drives the window draw (both
+        // passes), `sn` the negatives (fill pass only) — so the count
+        // pass never has to burn skip-draws to stay in sync
+        uint64_t s = seed ^ (0x9E3779B97F4A7C15ULL
+                             * static_cast<uint64_t>(p + 1));
+        int b = 1 + static_cast<int>(dl4j_sm64(&s)
+                                     % static_cast<uint64_t>(window));
+        if (!out) {
+            int64_t lo = p - b, hi = p + b;
+            if (lo < 0) lo = 0;
+            if (hi >= n) hi = n - 1;
+            for (int64_t j = lo; j <= hi; ++j) {
+                rows += (j != p) && (sid[j] == sid[p]);
+            }
+            continue;
+        }
+        uint64_t sn = s ^ 0xD1B54A32D192ED03ULL;
+        for (int off = -b; off <= b; ++off) {
+            if (off == 0) continue;
+            int64_t j = p + off;
+            if (j < 0 || j >= n || sid[j] != sid[p]) continue;
+            int32_t* row = out + rows * cols;
+            row[0] = corpus[p];
+            row[1] = corpus[j];
+            for (int k = 0; k < k_neg; ++k) {
+                row[2 + k] = dl4j_alias_draw(&sn, alias_prob,
+                                             alias_idx, vocab);
+            }
+            ++rows;
+        }
+    }
+    return rows;
+}
+
+// CBOW epoch pack: rows of [2*window context (-1 = empty slot),
+// center, K negatives], one row per position with >=1 context word.
+int64_t dl4j_w2v_cbow_pack(const int32_t* corpus, const int32_t* sid,
+                           int64_t n, int64_t p0, int64_t p1,
+                           int window, int k_neg,
+                           const float* alias_prob,
+                           const int32_t* alias_idx, int64_t vocab,
+                           uint64_t seed, int32_t* out) {
+    int64_t rows = 0;
+    const int w2 = 2 * window;
+    const int cols = w2 + 1 + k_neg;
+    if (p1 > n) p1 = n;
+    for (int64_t p = p0; p < p1; ++p) {
+        uint64_t s = seed ^ (0x9E3779B97F4A7C15ULL
+                             * static_cast<uint64_t>(p + 1));
+        int b = 1 + static_cast<int>(dl4j_sm64(&s)
+                                     % static_cast<uint64_t>(window));
+        int found = 0;
+        int32_t* row = out ? out + rows * cols : nullptr;
+        int slot = 0;
+        for (int off = -window; off <= window; ++off) {
+            if (off == 0) continue;
+            int64_t j = p + off;
+            int ok = (off >= -b && off <= b && j >= 0 && j < n
+                      && sid[j] == sid[p]);
+            if (row) row[slot] = ok ? corpus[j] : -1;
+            found += ok;
+            ++slot;
+        }
+        if (!found) continue;
+        if (row) {
+            uint64_t sn = s ^ 0xD1B54A32D192ED03ULL;
+            row[w2] = corpus[p];
+            for (int k = 0; k < k_neg; ++k) {
+                row[w2 + 1 + k] = dl4j_alias_draw(&sn, alias_prob,
+                                                  alias_idx, vocab);
+            }
+        }
+        ++rows;
+    }
+    return rows;
+}
+
+int dl4j_native_abi_version() { return 3; }
 
 }  // extern "C"

@@ -200,3 +200,94 @@ def test_file_sentence_iterator(tmp_path):
     it = FileSentenceIterator(str(tmp_path))
     assert list(it) == ["hello world", "second line", "third"]
     assert list(it) == ["hello world", "second line", "third"]  # re-iter
+
+
+@pytest.mark.parametrize(
+    "mode", ["negative", "hs", "cbow-negative", "cbow-hs"])
+def test_word2vec_dense_tier_semantic_clusters(mode):
+    """The dense tier (native epoch builder + slab-scan updates) learns
+    the same cluster structure as the scan tier in all four modes."""
+    sents, animals, tech = _corpus()
+    w2v = (Word2Vec.Builder()
+           .layer_size(24).window_size(4)
+           .negative_sample(5 if mode.endswith("negative") else 0)
+           .use_hierarchic_softmax(mode.endswith("hs"))
+           .elements_learning_algorithm(
+               "CBOW" if mode.startswith("cbow") else "SkipGram")
+           .min_word_frequency(1).epochs(6).seed(1)
+           .mode("dense")
+           .iterate(CollectionSentenceIterator(sents))
+           .build())
+    w2v.dense_batch_size = 512     # small batches for the tiny corpus
+    w2v.fit()
+    intra = np.mean([w2v.similarity("cat", "dog"),
+                     w2v.similarity("cpu", "gpu")])
+    inter = np.mean([w2v.similarity("cat", "cpu"),
+                     w2v.similarity("dog", "ram")])
+    assert intra > inter + 0.2, (intra, inter)
+
+
+def test_native_w2v_pack_shapes_and_distribution():
+    """The native epoch builders emit well-formed rows: correct window
+    structure, in-vocab negatives, and a negative distribution that
+    follows the alias tables."""
+    from deeplearning4j_tpu import native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(0)
+    V, n, window, K = 50, 4000, 3, 5
+    corpus = rng.integers(0, V, n).astype(np.int32)
+    sid = (np.arange(n) // 200).astype(np.int32)   # 200-token sequences
+    p = (np.arange(1, V + 1)[::-1] ** 0.75).astype(np.float64)
+    p /= p.sum()
+    # Vose tables
+    prob = np.zeros(V); alias = np.zeros(V, np.int32)
+    scaled = p * V
+    small = [i for i in range(V) if scaled[i] < 1.0]
+    large = [i for i in range(V) if scaled[i] >= 1.0]
+    while small and large:
+        s, l = small.pop(), large.pop()
+        prob[s] = scaled[s]; alias[s] = l
+        scaled[l] -= 1.0 - scaled[s]
+        (small if scaled[l] < 1.0 else large).append(l)
+    for i in small + large:
+        prob[i] = 1.0
+    pk = native.w2v_sg_pack(corpus, sid, window, K,
+                            prob.astype(np.float32), alias, 7)
+    assert pk.shape[1] == 2 + K
+    # every row's center/positive are real corpus values, negatives in-vocab
+    assert pk.min() >= 0 and pk.max() < V
+    # pair count is within the reduced-window envelope
+    assert n <= pk.shape[0] <= n * 2 * window
+    # negative marginal tracks the unigram^0.75 distribution
+    emp = np.bincount(pk[:, 2:].ravel(), minlength=V) / pk[:, 2:].size
+    assert np.corrcoef(emp, p)[0, 1] > 0.99
+    # determinism: same seed -> same pack
+    pk2 = native.w2v_sg_pack(corpus, sid, window, K,
+                             prob.astype(np.float32), alias, 7)
+    np.testing.assert_array_equal(pk, pk2)
+    # cbow layout: context slots either -1 or in-vocab, center col correct
+    ck = native.w2v_cbow_pack(corpus, sid, window, K,
+                              prob.astype(np.float32), alias, 7)
+    assert ck.shape[1] == 2 * window + 1 + K
+    assert ck[:, :2 * window].min() >= -1
+    assert set(np.unique(ck[:, 2 * window])) <= set(range(V))
+
+
+def test_word2vec_dense_lazy_tables_and_serialization(tmp_path):
+    """Dense-tier tables stay device-resident after fit and materialize
+    lazily through the properties; serialization sees numpy arrays."""
+    sents, _, _ = _corpus(n=60)
+    w2v = (Word2Vec.Builder().layer_size(8).epochs(1).seed(2)
+           .mode("dense")
+           .iterate(CollectionSentenceIterator(sents)).build())
+    w2v.fit()
+    assert w2v._syn0_dev is not None or w2v._syn0_host is not None
+    arr = w2v.syn0
+    assert isinstance(arr, np.ndarray) and arr.ndim == 2
+    p = tmp_path / "vecs.txt"
+    WordVectorSerializer.write_word_vectors(w2v, p)
+    loaded = WordVectorSerializer.read_word_vectors(p)
+    np.testing.assert_allclose(loaded.get_word_vector("cat"),
+                               w2v.get_word_vector("cat"), atol=1e-5)
