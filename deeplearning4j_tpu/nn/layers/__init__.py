@@ -26,3 +26,4 @@ from deeplearning4j_tpu.nn.layers.recurrent import (  # noqa: F401
 )
 from deeplearning4j_tpu.nn.layers.variational import VariationalAutoencoder  # noqa: F401
 from deeplearning4j_tpu.nn.layers.feedforward import AutoEncoder  # noqa: F401
+from deeplearning4j_tpu.nn.layers.rbm import RBM  # noqa: F401

@@ -568,15 +568,18 @@ class MultiLayerNetwork:
 
     # ----------------------------------------------------------- pretraining
     def pretrain(self, data_iterator, epochs: int = 1):
-        """Greedy layerwise unsupervised pretraining for AE/VAE layers
-        (ref: MLN.pretrain path at fit():1075-1078)."""
+        """Greedy layerwise unsupervised pretraining for AE/VAE/RBM
+        layers (ref: MLN.pretrain path at fit():1075-1078; RBM CD-k via
+        nn/layers/rbm.py's free-energy surrogate)."""
         from deeplearning4j_tpu.nn.layers.feedforward import AutoEncoder
+        from deeplearning4j_tpu.nn.layers.rbm import RBM
         from deeplearning4j_tpu.nn.layers.variational import VariationalAutoencoder
 
         if self.params is None:
             self.init()
         for li, layer in enumerate(self.conf.layers):
-            if not isinstance(layer, (AutoEncoder, VariationalAutoencoder)):
+            if not isinstance(layer,
+                              (AutoEncoder, VariationalAutoencoder, RBM)):
                 continue
             if layer.frozen:
                 continue
