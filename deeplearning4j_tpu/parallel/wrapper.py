@@ -51,8 +51,17 @@ class ParallelWrapper:
 
     def __init__(self, net, workers: Optional[int] = None, tp: int = 1,
                  averaging_frequency: int = 1, average_updaters: bool = True,
-                 mesh: Optional[Mesh] = None, prefetch_buffer: int = 2):
+                 mesh: Optional[Mesh] = None, prefetch_buffer: int = 2,
+                 threshold_compression: float = 0.0):
         self.net = net
+        self.threshold_compression = float(threshold_compression)
+        if (self.threshold_compression > 0.0
+                and max(1, averaging_frequency) <= 1):
+            raise ValueError(
+                "threshold_compression requires averaging_frequency > 1 "
+                "(it encodes the k-step delta at the local-SGD "
+                "rendezvous; the per-step GSPMD all-reduce path has no "
+                "host-visible exchange to encode)")
         if mesh is None:
             n = len(jax.devices())
             workers = workers if workers is not None else max(1, n // tp)
@@ -80,6 +89,14 @@ class ParallelWrapper:
                 "output graphs only")
         if self.net.params is None:
             self.net.init()
+        # the grad-over-flat carry (updater/flat_chain.py) concatenates
+        # every parameter into ONE flat vector — under a tp-sharded or
+        # GSPMD-driven net that forces a full all-gather of the model
+        # each step and deadlocked the virtual-mesh dryrun; mesh-driven
+        # training always uses the per-layer tree path
+        if hasattr(self.net, "_flat_chain"):
+            self.net._materialize_flat()
+            self.net._flat_chain = None
         put = lambda tree: jax.tree_util.tree_map(
             lambda x, s: jax.device_put(x, s),
             tree, param_shardings(self.mesh, tree))
@@ -144,7 +161,8 @@ class ParallelWrapper:
         k = self.averaging_frequency
         if k > 1 and self._local_step is None:
             self._local_step = LocalStepTrainer(
-                net, self.mesh, average_updaters=self.average_updaters)
+                net, self.mesh, average_updaters=self.average_updaters,
+                threshold=self.threshold_compression)
         with self.mesh:
             for _ in range(epochs):
                 if hasattr(batches, "reset"):
@@ -258,7 +276,16 @@ class LocalStepTrainer:
     and the wrapped net must not be in TBPTT carry mode.
     """
 
-    def __init__(self, net, mesh: Mesh, average_updaters: bool = True):
+    def __init__(self, net, mesh: Mesh, average_updaters: bool = True,
+                 threshold: float = 0.0):
+        """`threshold > 0` enables threshold compression of the k-step
+        parameter delta at each rendezvous (the reference's
+        EncodingHandler.java:57-73 role, composed with local SGD): each
+        shard sends sign(delta+residual)*threshold only where
+        |delta+residual| >= threshold and keeps the remainder in a
+        per-shard residual accumulator, so successive rendezvous
+        eventually deliver everything. `wire_stats()` reports the
+        resulting bytes-on-wire vs a dense exchange."""
         if mesh.shape["tp"] != 1:
             raise NotImplementedError(
                 "averaging_frequency > 1 requires tp == 1 (local-SGD "
@@ -271,7 +298,12 @@ class LocalStepTrainer:
         self.net = net
         self.mesh = mesh
         self.average_updaters = average_updaters
+        self.threshold = float(threshold)
         self._fn_cache = {}
+        self._residual = None
+        self._sent_nnz = []          # per-rendezvous device scalars
+        self._param_entries = None
+        self._n_rendezvous = 0
 
     # -------------------------------------------------------------- build
     def _build(self, k: int, with_fm: bool, with_lm: bool):
@@ -325,8 +357,10 @@ class LocalStepTrainer:
                       params[i], grads[i], upd_states[i])
                      for i in range(len(params))], lr, step)
 
-        def worker(params, upd_states, states, step0, xs, ys, fms, lms,
-                   rng, lr_scale):
+        thr = self.threshold
+
+        def worker(params, upd_states, states, residual, step0, xs, ys,
+                   fms, lms, rng, lr_scale):
             # decorrelate dropout across shards
             rng = jax.random.fold_in(rng, jax.lax.axis_index("dp"))
             keys = jax.random.split(rng, k)
@@ -343,30 +377,101 @@ class LocalStepTrainer:
                     params, upd_states, grads, lr, step)
                 return (params, upd_states, new_states, step + 1), loss
 
+            params0 = params
             (params, upd_states, states, _), losses = jax.lax.scan(
                 one, (params, upd_states, states, step0),
                 (xs, ys, fms, lms, keys))
             # rendezvous: average over dp
             pmean = lambda t: jax.tree_util.tree_map(
                 lambda a: jax.lax.pmean(a, "dp"), t)
-            params = pmean(params)
+            if thr > 0.0:
+                # threshold-encode the k-step delta with residual carry
+                # (EncodingHandler.java:57-73 role): only +-thr spikes
+                # cross the wire; the remainder waits in `residual`
+                def encode(p0, p1, res):
+                    acc = (p1 - p0) + res[0]
+                    send = jnp.where(jnp.abs(acc) >= thr,
+                                     jnp.sign(acc) * thr, 0.0)
+                    return send, (acc - send)[None]
+                flat0, treedef = jax.tree_util.tree_flatten(params0)
+                flat1 = jax.tree_util.tree_leaves(params)
+                flatr = jax.tree_util.tree_leaves(residual)
+                sends, new_res = [], []
+                nnz = jnp.zeros((), jnp.float32)
+                for p0, p1, res in zip(flat0, flat1, flatr):
+                    send, r = encode(p0, p1, res)
+                    sends.append(send)
+                    new_res.append(r)
+                    nnz = nnz + jnp.count_nonzero(
+                        send).astype(jnp.float32)
+                avg = [jax.lax.pmean(sv, "dp") for sv in sends]
+                params = jax.tree_util.tree_unflatten(
+                    treedef, [p0 + a for p0, a in zip(flat0, avg)])
+                residual = jax.tree_util.tree_unflatten(
+                    treedef, new_res)
+                nnz = jax.lax.psum(nnz, "dp")
+            else:
+                params = pmean(params)
+                nnz = jnp.zeros((), jnp.float32)
             states = pmean(states)
             if avg_upd:
                 upd_states = pmean(upd_states)
             return (params, upd_states, states,
-                    jax.lax.pmean(jnp.mean(losses), "dp"))
+                    jax.lax.pmean(jnp.mean(losses), "dp"),
+                    residual, nnz)
 
         rep = P()             # replicated at entry/exit
         xspec = P(None, "dp")  # [k, batch, ...]: batch dim sharded
         fspec = xspec if with_fm else rep
         lspec = xspec if with_lm else rep
+        rspec = P("dp")       # per-shard residual, [dp, ...] outside
         return jax.jit(jax.shard_map(
             worker, mesh=self.mesh,
-            in_specs=(rep, rep, rep, rep, xspec, xspec, fspec, lspec,
-                      rep, rep),
-            out_specs=(rep, rep, rep, rep),
+            in_specs=(rep, rep, rep, rspec, rep, xspec, xspec, fspec,
+                      lspec, rep, rep),
+            out_specs=(rep, rep, rep, rep, rspec, rep),
             check_vma=False),
-            donate_argnums=(0, 1, 2))
+            donate_argnums=(0, 1, 2, 3))
+
+    def _init_residual(self):
+        """Per-shard residual accumulators, zero-initialized with a
+        [dp, ...] layout sharded over dp (each shard owns its own)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if self.threshold <= 0.0:
+            return {}      # no compression: no residual state to carry
+        dp = self.mesh.shape["dp"]
+        params = self.net.params
+        if self._param_entries is None:
+            self._param_entries = sum(
+                int(np.prod(a.shape))
+                for a in jax.tree_util.tree_leaves(params))
+        sh = NamedSharding(self.mesh, P("dp"))
+
+        def zeros():
+            return jax.tree_util.tree_map(
+                lambda a: jnp.zeros((dp,) + a.shape, a.dtype), params)
+
+        return jax.jit(zeros, out_shardings=sh)()
+
+    def wire_stats(self):
+        """Bytes-on-wire accounting for the rendezvous exchanges (the
+        WiredEncodingHandler.java:40-57 role): dense = full param
+        all-reduce per rendezvous; compressed = 4 bytes per threshold
+        spike (the reference's integer wire format encodes sign in the
+        index)."""
+        n = self._n_rendezvous
+        if self._param_entries is None or self.threshold <= 0.0 or not n:
+            return {"threshold": self.threshold, "rendezvous": n,
+                    "bytes_dense": None, "bytes_compressed": None,
+                    "compression_ratio": None}
+        sent = float(sum(float(v) for v in self._sent_nnz))
+        dense = float(self._param_entries) * 4.0 * n \
+            * self.mesh.shape["dp"]
+        comp = sent * 4.0
+        return {"threshold": self.threshold, "rendezvous": n,
+                "bytes_dense": dense, "bytes_compressed": comp,
+                "compression_ratio": comp / dense if dense else None}
 
     # ---------------------------------------------------------------- run
     def run(self, group):
@@ -453,12 +558,21 @@ class LocalStepTrainer:
             self._fn_cache[key] = self._build(
                 k, fms_in is not None, lms_in is not None)
         net._rng, sub = jax.random.split(net._rng)
-        (net.params, net.updater_states, net.states, loss) = \
-            self._fn_cache[key](
+        if self._residual is None:
+            self._residual = self._init_residual()
+        (net.params, net.updater_states, net.states, loss,
+         self._residual, nnz) = self._fn_cache[key](
                 net.params, net.updater_states, net.states,
+                self._residual,
                 jnp.asarray(net.iteration, jnp.int32),
                 xs_in, ys_in, fms_in, lms_in, sub,
                 jnp.asarray(net._lr_score_factor, jnp.float32))
+        if self.threshold > 0.0:
+            # keep per-rendezvous device scalars; summed (in f64-safe
+            # host arithmetic) only when wire_stats() is read, so the
+            # hot loop never syncs
+            self._sent_nnz.append(nnz)
+            self._n_rendezvous += 1
         net.iteration += k
         net._score = loss
         net._apply_score_decay(loss)

@@ -332,3 +332,87 @@ def test_solver_under_parallel_wrapper_raises(rng):
     y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
     with pytest.raises(NotImplementedError, match="line-search"):
         ParallelWrapper(net, mesh=mesh).fit([(x, y)])
+
+
+def test_threshold_compression_tracks_dense_local_sgd(rng):
+    """Threshold-encoded rendezvous (EncodingHandler.java:57-73 role)
+    trains to a loss close to the dense local-SGD average, and the wire
+    accounting shows real byte savings."""
+    from deeplearning4j_tpu.parallel.wrapper import LocalStepTrainer
+
+    # learnable labels (random labels have an irreducible ln(4) loss)
+    proj = rng.normal(size=(8, 4)).astype(np.float32)
+
+    def _learnable(n=16):
+        x = rng.normal(size=(n, 8)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[np.argmax(x @ proj, axis=1)]
+        return x, y
+
+    batches = [_learnable() for _ in range(16)]
+    mesh = make_mesh(dp=2, devices=_cpu_devices(2))
+
+    def run(threshold):
+        net = _net()
+        pw = ParallelWrapper(net, mesh=mesh, averaging_frequency=4,
+                             threshold_compression=threshold)
+        pw.fit(batches, epochs=4)
+        return net, pw
+
+    dense_net, _ = run(0.0)
+    comp_net, comp_pw = run(3e-2)
+    dense_loss = float(dense_net.score())
+    comp_loss = float(comp_net.score())
+    # both train (loss well below initial ~ln(4)=1.386) and agree
+    assert dense_loss < 1.0 and comp_loss < 1.0
+    assert abs(dense_loss - comp_loss) < 0.25, (dense_loss, comp_loss)
+    wire = comp_pw._local_step.wire_stats()
+    assert wire["rendezvous"] == 16
+    assert 0 < wire["bytes_compressed"] < wire["bytes_dense"]
+    assert 0 < wire["compression_ratio"] < 1
+
+
+def test_threshold_compression_residual_carries_unsent_mass(rng):
+    """With an unreachably large threshold nothing crosses the wire:
+    params stay at the rendezvous start and ALL local progress lives in
+    the residual accumulator (delivered once it crosses threshold)."""
+    batches = [_data(rng, n=16) for _ in range(2)]
+    mesh = make_mesh(dp=2, devices=_cpu_devices(2))
+    net = _net()
+    before = jax.tree_util.tree_map(np.asarray, net.params)
+    pw = ParallelWrapper(net, mesh=mesh, averaging_frequency=2,
+                         threshold_compression=1e9)
+    pw.fit(batches)
+    for a, b in zip(jax.tree_util.tree_leaves(before),
+                    jax.tree_util.tree_leaves(net.params)):
+        np.testing.assert_allclose(np.asarray(b), a, atol=1e-7)
+    res = jax.tree_util.tree_leaves(pw._local_step._residual)
+    assert max(float(np.max(np.abs(np.asarray(r)))) for r in res) > 0
+    wire = pw._local_step.wire_stats()
+    assert wire["bytes_compressed"] == 0.0
+
+
+def test_threshold_compression_via_training_master(rng, tmp_path):
+    """TrainingMaster(threshold_compression=...) end-to-end on the
+    virtual mesh: trains, and training_stats carries wire accounting."""
+    from deeplearning4j_tpu.parallel.training_master import TrainingMaster
+
+    mesh = make_mesh(dp=4, devices=_cpu_devices(4))
+    net = _net()
+    data = [_data(rng, n=32) for _ in range(8)]
+    tm = TrainingMaster(net, mesh=mesh, averaging_frequency=4,
+                        threshold_compression=1e-4)
+    tm.fit(lambda s: data[s], num_steps=8,
+           collect_training_stats=True)
+    stats = tm.training_stats()
+    wire = stats["wire"]
+    assert wire["rendezvous"] == 2
+    assert 0 < wire["compression_ratio"] < 1
+    assert np.isfinite(float(net.score()))
+
+
+def test_threshold_compression_requires_local_sgd():
+    from deeplearning4j_tpu.parallel.training_master import TrainingMaster
+
+    with pytest.raises(ValueError):
+        TrainingMaster(_net(), averaging_frequency=1,
+                       threshold_compression=1e-3)
