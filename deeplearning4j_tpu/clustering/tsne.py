@@ -5,16 +5,22 @@ plot/BarnesHutTsne.java (863 LoC) — perplexity-calibrated conditional
 probabilities (binary search over precision), early exaggeration,
 momentum gradient descent on the KL divergence.
 
-TPU-native design: EXACT O(N^2) t-SNE formulated as dense matrix ops —
-the full P/Q affinity matrices ride the MXU, the per-point beta binary
-search is vectorized (all rows at once, fixed 50 halvings via
-lax.while-free masking), and one gradient iteration is one jitted
-program. The reference's Barnes-Hut quadtree exists to make O(N^2)
-affordable on a CPU; a pointer quadtree is the worst possible TPU
-shape, while N<=20k visualization workloads fit the dense formulation
-comfortably (N=10k -> a 100M-entry f32 matrix = 400 MB, streamable).
-`theta` is accepted for API parity and ignored (exact mode), matching
-BarnesHutTsne(theta=0) semantics.
+TPU-native design, two tiers (method='auto'|'exact'|'chunked'):
+
+- exact (N <= 16384): the full P/Q affinity matrices ride the MXU, the
+  per-point beta binary search is vectorized (all rows at once), one
+  gradient iteration is one jitted program.
+- chunked (N beyond the dense cap — the BarnesHutTsne.java role): P is
+  sparse over each point's 3*perplexity nearest neighbors (exactly the
+  reference's VPTree-KNN input stage, BarnesHutTsne.java), calibrated
+  and symmetrized on the sparse pattern; the repulsive Q side streams
+  in [row_block, N] blocks inside one jitted scan, so memory is
+  O(N*row_block + N*K) instead of O(N^2). No quadtree — a pointer tree
+  is the worst possible TPU shape; dense row-blocks at theta=0
+  exactness replace it.
+
+`theta` is accepted for API parity and ignored (both tiers are exact
+in the repulsive term), matching BarnesHutTsne(theta=0) semantics.
 """
 
 from __future__ import annotations
@@ -29,19 +35,17 @@ import numpy as np
 from deeplearning4j_tpu.clustering.distances import pairwise_distance
 
 
-@partial(jax.jit, static_argnames=("perplexity_iters",))
-def _p_conditional(x, perplexity, perplexity_iters: int = 50):
-    """Row-calibrated conditional affinities: binary-search beta_i so
-    each row's entropy == log(perplexity) (ref Tsne.java hBeta loop)."""
-    d2 = pairwise_distance(x, x, "sqeuclidean")
-    n = d2.shape[0]
-    eye = jnp.eye(n, dtype=bool)
-    d2 = jnp.where(eye, 0.0, d2)
+def _beta_search(d2, drop_mask, perplexity, iters):
+    """Shared perplexity calibration (ref Tsne.java hBeta loop):
+    binary-search beta_i so each row of exp(-d2*beta) has entropy
+    log(perplexity). `drop_mask` (or None) marks excluded entries
+    (the diagonal in the dense tier)."""
     log_u = jnp.log(perplexity)
 
     def entropy_probs(beta):
         p = jnp.exp(-d2 * beta[:, None])
-        p = jnp.where(eye, 0.0, p)
+        if drop_mask is not None:
+            p = jnp.where(drop_mask, 0.0, p)
         sum_p = jnp.maximum(jnp.sum(p, axis=1), 1e-12)
         h = jnp.log(sum_p) + beta * jnp.sum(d2 * p, axis=1) / sum_p
         return h, p / sum_p[:, None]
@@ -56,13 +60,21 @@ def _p_conditional(x, perplexity, perplexity_iters: int = 50):
         beta = jnp.where(jnp.isinf(hi), beta * 2, (lo + hi) / 2)
         return (beta, lo, hi), None
 
-    beta0 = jnp.ones((n,))
-    lo0 = jnp.zeros((n,))
-    hi0 = jnp.full((n,), jnp.inf)
+    n = d2.shape[0]
     (beta, _, _), _ = jax.lax.scan(
-        body, (beta0, lo0, hi0), None, length=perplexity_iters)
+        body, (jnp.ones((n,)), jnp.zeros((n,)), jnp.full((n,), jnp.inf)),
+        None, length=iters)
     _, p = entropy_probs(beta)
     return p
+
+
+@partial(jax.jit, static_argnames=("perplexity_iters",))
+def _p_conditional(x, perplexity, perplexity_iters: int = 50):
+    """Dense-tier conditional affinities (diagonal excluded)."""
+    d2 = pairwise_distance(x, x, "sqeuclidean")
+    eye = jnp.eye(d2.shape[0], dtype=bool)
+    return _beta_search(jnp.where(eye, 0.0, d2), eye, perplexity,
+                        perplexity_iters)
 
 
 @jax.jit
@@ -78,17 +90,110 @@ def _tsne_grad(y, p, exaggeration):
     return grad, kl
 
 
+@partial(jax.jit, static_argnames=("perplexity_iters",))
+def _p_sparse(d2, perplexity, perplexity_iters: int = 50):
+    """Conditional affinities over each row's K nearest neighbors
+    ([N,K] sq-distances) — the sparse analogue of _p_conditional (ref
+    BarnesHutTsne computeGaussianPerplexity over the KNN set)."""
+    return _beta_search(d2, None, perplexity, perplexity_iters)
+
+
+@partial(jax.jit, static_argnames=("n_total",))
+def _symmetrize_block(idx_blk, p_blk, row0, idx_all, p_all,
+                      n_total: int):
+    """((p_ij + p_ji) / 2N, mutual) for one row block: p_ji is
+    recovered by matching i inside the neighbor lists of the block's
+    neighbors ([B,K,K] compare — the sparse-transpose lookup as a
+    dense batched op). `mutual` marks edges present in BOTH KNN lists;
+    non-mutual edges additionally act on the REVERSE endpoint via a
+    scatter in _chunked_step (BarnesHutTsne's union-pattern
+    symmetrization, restructured for fixed shapes)."""
+    B, K = idx_blk.shape
+    rows = row0 + jnp.arange(B)
+    nbr_of_nbr = idx_all[idx_blk]          # [B,K,K]
+    match = nbr_of_nbr == rows[:, None, None]
+    mutual = jnp.any(match, axis=-1)                    # [B,K]
+    p_back = jnp.sum(p_all[idx_blk] * match, axis=-1)   # [B,K]
+    return (p_blk + p_back) / (2.0 * n_total), mutual
+
+
+@partial(jax.jit, static_argnames=("row_block", "n_real"))
+def _chunked_step(y, idx, psym, mutual, exaggeration, row_block: int,
+                  n_real: int):
+    """One gradient iteration with the repulsive term streamed over
+    [row_block, N] blocks: returns (grad [n_real,C], kl). One scan
+    accumulates BOTH the partition constant Z and the unscaled
+    repulsive blocks (1/Z is a scalar, applied after). `y` is padded
+    to a multiple of row_block with far-away sentinel rows (their
+    student-t kernel ~ 0; masked anyway)."""
+    n_pad, C = y.shape
+    nb = n_pad // row_block
+
+    # attractive term + sparse KL: gathers over the KNN pattern
+    y_real = y[:n_real]
+    yj = y[idx]                                   # [n_real,K,C]
+    diff = y_real[:, None, :] - yj
+    d2a = jnp.sum(diff * diff, axis=-1)
+    numa = 1.0 / (1.0 + d2a)                      # [n_real,K]
+    w = psym * exaggeration * numa
+    f_attr = 4.0 * jnp.sum(w[:, :, None] * diff, axis=1)
+    # union-pattern completion: a NON-mutual edge i->j also attracts
+    # its reverse endpoint j with the same symmetrized mass
+    # (BarnesHutTsne symmetrization; mutual edges already appear in
+    # both rows' patterns)
+    w_rev = jnp.where(mutual, 0.0, w)
+    f_attr = f_attr.at[idx.reshape(-1)].add(
+        4.0 * (w_rev[:, :, None] * (-diff)).reshape(-1, C))
+
+    y_blocks = y.reshape(nb, row_block, C)
+    row_ids = jnp.arange(n_pad).reshape(nb, row_block)
+    col_pad = jnp.arange(n_pad)[None, :] >= n_real
+
+    def body(z, xs):
+        yb, rb = xs
+        d2 = (jnp.sum(yb * yb, axis=1)[:, None]
+              + jnp.sum(y * y, axis=1)[None, :] - 2.0 * yb @ y.T)
+        num = 1.0 / (1.0 + jnp.maximum(d2, 0.0))
+        self_mask = rb[:, None] == jnp.arange(n_pad)[None, :]
+        num = jnp.where(self_mask | col_pad, 0.0, num)
+        real_rows = (rb < n_real)[:, None]
+        z = z + jnp.sum(jnp.where(real_rows, num, 0.0))
+        num2 = num * num
+        f_rep_unscaled = (jnp.sum(num2, axis=1)[:, None] * yb
+                          - num2 @ y)
+        return z, f_rep_unscaled
+
+    Z, f_rep_blocks = jax.lax.scan(
+        body, jnp.zeros(()), (y_blocks, row_ids))
+    Z = jnp.maximum(Z, 1e-12)
+    f_rep = -4.0 / Z * f_rep_blocks.reshape(n_pad, C)[:n_real]
+
+    grad = f_attr + f_rep
+    q_sparse = jnp.maximum(numa / Z, 1e-12)
+    p_safe = jnp.maximum(psym, 1e-12)
+    kl_terms = psym * jnp.log(p_safe / q_sparse)
+    # count non-mutual pairs from both endpoints, like the dense tier's
+    # ordered-pair sum counts every pair twice
+    kl = jnp.sum(kl_terms) + jnp.sum(
+        jnp.where(mutual, 0.0, kl_terms))
+    return grad, kl
+
+
 class Tsne:
     """ref: BarnesHutTsne builder — nDims, perplexity, theta (ignored:
     exact mode), learningRate, maxIter, momentum schedule, early
     exaggeration (stopLyingIteration)."""
+
+    # dense-tier cap: above this fit_transform streams (method='auto')
+    DENSE_CAP = 16384
 
     def __init__(self, n_components: int = 2, perplexity: float = 30.0,
                  theta: float = 0.5, learning_rate: float = 200.0,
                  max_iter: int = 500, early_exaggeration: float = 12.0,
                  stop_lying_iteration: int = 100,
                  initial_momentum: float = 0.5, final_momentum: float = 0.8,
-                 momentum_switch: int = 250, seed: int = 0):
+                 momentum_switch: int = 250, seed: int = 0,
+                 method: str = "auto", row_block: int = 2048):
         self.n_components = n_components
         self.perplexity = perplexity
         self.theta = theta
@@ -100,15 +205,24 @@ class Tsne:
         self.final_momentum = final_momentum
         self.momentum_switch = momentum_switch
         self.seed = seed
+        if method not in ("auto", "exact", "chunked"):
+            raise ValueError(
+                f"method must be auto|exact|chunked: {method}")
+        self.method = method
+        self.row_block = int(row_block)
         self.kl_: Optional[float] = None
 
     def fit_transform(self, x) -> np.ndarray:
-        x = jnp.asarray(np.asarray(x, np.float32))
+        x = np.asarray(x, np.float32)
         n = x.shape[0]
         if n - 1 < 3 * self.perplexity:
             raise ValueError(
                 f"perplexity {self.perplexity} too large for {n} points "
                 "(need n-1 >= 3*perplexity)")
+        if self.method == "chunked" or (self.method == "auto"
+                                        and n > self.DENSE_CAP):
+            return self._fit_chunked(x)
+        x = jnp.asarray(x)
         p_cond = _p_conditional(x, self.perplexity)
         p = (p_cond + p_cond.T) / (2.0 * n)   # symmetrize (Tsne.java)
         p = jnp.maximum(p, 1e-12)
@@ -126,6 +240,58 @@ class Tsne:
             vel = mom * vel - self.learning_rate * grad
             y = y + vel
             y = y - jnp.mean(y, axis=0)   # keep centered
+        self.kl_ = float(kl)
+        return np.asarray(y)
+
+    def _fit_chunked(self, x: np.ndarray) -> np.ndarray:
+        """Streamed tier (BarnesHutTsne.java role): KNN-sparse P +
+        row-block-streamed repulsive term; memory O(N*row_block +
+        N*K)."""
+        from deeplearning4j_tpu.clustering.distances import knn
+
+        n = x.shape[0]
+        k = min(int(3 * self.perplexity), n - 1)
+        idx, dist = knn(x, x, k + 1, metric="euclidean",
+                        tile=self.row_block)
+        # drop each row's self entry (first occurrence; falls back to
+        # the farthest column when duplicates displaced it)
+        is_self = idx == np.arange(n)[:, None]
+        is_self[np.cumsum(is_self, axis=1) > 1] = False
+        order = np.argsort(is_self, axis=1, kind="stable")
+        idx = np.take_along_axis(idx, order, 1)[:, :k].astype(np.int32)
+        d = np.take_along_axis(dist, order, 1)[:, :k]
+        p = _p_sparse(jnp.asarray(d * d), self.perplexity)
+
+        idx_j = jnp.asarray(idx)
+        blk = min(self.row_block, n)
+        parts, mut_parts = [], []
+        for r0 in range(0, n, blk):
+            r1 = min(r0 + blk, n)
+            ps, mu = _symmetrize_block(
+                idx_j[r0:r1], p[r0:r1], jnp.int32(r0), idx_j, p, n)
+            parts.append(ps)
+            mut_parts.append(mu)
+        psym = jnp.maximum(jnp.concatenate(parts, axis=0), 1e-12)
+        mutual = jnp.concatenate(mut_parts, axis=0)
+
+        n_pad = -(-n // blk) * blk
+        key = jax.random.PRNGKey(self.seed)
+        y = 1e-4 * jax.random.normal(key, (n, self.n_components))
+        vel = jnp.zeros_like(y)
+        # sentinel rows sit far away: their kernel vs everything ~ 0
+        pad_rows = jnp.full((n_pad - n, self.n_components), 1e6)
+        kl = None
+        for it in range(self.max_iter):
+            ex = (self.early_exaggeration
+                  if it < self.stop_lying_iteration else 1.0)
+            mom = (self.initial_momentum
+                   if it < self.momentum_switch else self.final_momentum)
+            y_pad = jnp.concatenate([y, pad_rows], axis=0)
+            grad, kl = _chunked_step(y_pad, idx_j, psym, mutual, ex,
+                                     blk, n)
+            vel = mom * vel - self.learning_rate * grad
+            y = y + vel
+            y = y - jnp.mean(y, axis=0)
         self.kl_ = float(kl)
         return np.asarray(y)
 

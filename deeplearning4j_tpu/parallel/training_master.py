@@ -69,15 +69,14 @@ class TrainingMaster:
         if mesh is None:
             mesh = make_mesh(dp=len(jax.devices()))
         self.mesh = mesh
+        from deeplearning4j_tpu.parallel.wrapper import (
+            _require_local_sgd,
+        )
+
         self.averaging_frequency = max(1, averaging_frequency)
         self.threshold_compression = float(threshold_compression)
-        if (self.threshold_compression > 0.0
-                and self.averaging_frequency <= 1):
-            raise ValueError(
-                "threshold_compression requires averaging_frequency > 1 "
-                "(it encodes the k-step delta at the local-SGD "
-                "rendezvous; the per-step GSPMD all-reduce path has no "
-                "host-visible exchange to encode)")
+        _require_local_sgd(self.averaging_frequency,
+                           self.threshold_compression)
         self._staged = False
         self._local_step = None
 
@@ -119,13 +118,13 @@ class TrainingMaster:
     def _stage_net(self):
         if self._staged:
             return
+        from deeplearning4j_tpu.parallel.wrapper import (
+            _disable_flat_chain,
+        )
+
         if self.net.params is None:
             self.net.init()
-        # disable the grad-over-flat carry under the mesh (see
-        # ParallelWrapper._ensure_sharded)
-        if hasattr(self.net, "_flat_chain"):
-            self.net._materialize_flat()
-            self.net._flat_chain = None
+        _disable_flat_chain(self.net)
         self.net.params = self._replicated(self.net.params)
         self.net.updater_states = self._replicated(self.net.updater_states)
         self.net.states = self._replicated(self.net.states)

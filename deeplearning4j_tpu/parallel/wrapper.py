@@ -40,6 +40,28 @@ from deeplearning4j_tpu.parallel.sharding import (
 )
 
 
+def _require_local_sgd(averaging_frequency: int, threshold: float):
+    """Shared validation: threshold compression only exists at the
+    local-SGD rendezvous."""
+    if threshold > 0.0 and max(1, averaging_frequency) <= 1:
+        raise ValueError(
+            "threshold_compression requires averaging_frequency > 1 "
+            "(it encodes the k-step delta at the local-SGD rendezvous; "
+            "the per-step GSPMD all-reduce path has no host-visible "
+            "exchange to encode)")
+
+
+def _disable_flat_chain(net):
+    """The grad-over-flat carry (updater/flat_chain.py) concatenates
+    every parameter into ONE flat vector — under a tp-sharded or
+    GSPMD-driven net that forces a full all-gather of the model each
+    step (it deadlocked the virtual-mesh dryrun); mesh-driven training
+    always uses the per-layer tree path."""
+    if hasattr(net, "_flat_chain"):
+        net._materialize_flat()
+        net._flat_chain = None
+
+
 class ParallelWrapper:
     """Data/tensor-parallel trainer around a MultiLayerNetwork/ComputationGraph.
 
@@ -55,13 +77,8 @@ class ParallelWrapper:
                  threshold_compression: float = 0.0):
         self.net = net
         self.threshold_compression = float(threshold_compression)
-        if (self.threshold_compression > 0.0
-                and max(1, averaging_frequency) <= 1):
-            raise ValueError(
-                "threshold_compression requires averaging_frequency > 1 "
-                "(it encodes the k-step delta at the local-SGD "
-                "rendezvous; the per-step GSPMD all-reduce path has no "
-                "host-visible exchange to encode)")
+        _require_local_sgd(averaging_frequency,
+                           self.threshold_compression)
         if mesh is None:
             n = len(jax.devices())
             workers = workers if workers is not None else max(1, n // tp)
@@ -89,14 +106,7 @@ class ParallelWrapper:
                 "output graphs only")
         if self.net.params is None:
             self.net.init()
-        # the grad-over-flat carry (updater/flat_chain.py) concatenates
-        # every parameter into ONE flat vector — under a tp-sharded or
-        # GSPMD-driven net that forces a full all-gather of the model
-        # each step and deadlocked the virtual-mesh dryrun; mesh-driven
-        # training always uses the per-layer tree path
-        if hasattr(self.net, "_flat_chain"):
-            self.net._materialize_flat()
-            self.net._flat_chain = None
+        _disable_flat_chain(self.net)
         put = lambda tree: jax.tree_util.tree_map(
             lambda x, s: jax.device_put(x, s),
             tree, param_shardings(self.mesh, tree))
@@ -459,16 +469,28 @@ class LocalStepTrainer:
         WiredEncodingHandler.java:40-57 role): dense = full param
         all-reduce per rendezvous; compressed = 4 bytes per threshold
         spike (the reference's integer wire format encodes sign in the
-        index)."""
+        index). The updater-state and BN-state averages stay DENSE in
+        both modes and are counted in both totals, so the ratio
+        reflects the whole rendezvous, not just the params."""
         n = self._n_rendezvous
         if self._param_entries is None or self.threshold <= 0.0 or not n:
             return {"threshold": self.threshold, "rendezvous": n,
                     "bytes_dense": None, "bytes_compressed": None,
                     "compression_ratio": None}
+        aux_entries = sum(
+            int(np.prod(a.shape))
+            for a in jax.tree_util.tree_leaves(self.net.states))
+        if self.average_updaters:
+            aux_entries += sum(
+                int(np.prod(a.shape))
+                for a in jax.tree_util.tree_leaves(
+                    self.net.updater_states))
+        dp = self.mesh.shape["dp"]
         sent = float(sum(float(v) for v in self._sent_nnz))
-        dense = float(self._param_entries) * 4.0 * n \
-            * self.mesh.shape["dp"]
-        comp = sent * 4.0
+        dense_params = float(self._param_entries) * 4.0 * n * dp
+        aux = float(aux_entries) * 4.0 * n * dp
+        comp = sent * 4.0 + aux
+        dense = dense_params + aux
         return {"threshold": self.threshold, "rendezvous": n,
                 "bytes_dense": dense, "bytes_compressed": comp,
                 "compression_ratio": comp / dense if dense else None}

@@ -2,10 +2,13 @@
 
 Parity: the reference's Play UI train module (ui/play/PlayUIServer.java,
 ui/module/train/TrainModule.java — score chart, mean-magnitude
-timelines, histograms, system tab). TPU-native difference: a
-dependency-free self-contained HTML file (inline SVG charts, data
-embedded as JSON) — no Play framework, no websockets; the UIServer
-re-renders on each GET, which at listener frequencies is milliseconds.
+timelines, histograms, system tab; conv-activation grids via the
+activations view, and the t-SNE tab ui/module/tsne/). TPU-native
+difference: a dependency-free self-contained HTML file (inline SVG
+charts, data embedded as JSON) — no Play framework, no websockets; the
+UIServer re-renders on each GET, which at listener frequencies is
+milliseconds. `collect_conv_activations` + `embedding_scatter` build
+the two extra tabs' data from a live net; pass them to render_html.
 """
 
 from __future__ import annotations
@@ -38,6 +41,10 @@ _PAGE = """<!DOCTYPE html>
 <div id="umm" class="row"></div>
 <h2>Latest parameter histograms</h2>
 <div id="hists" class="row"></div>
+<h2>Convolutional activations</h2>
+<div id="acts" class="row"></div>
+<h2>Embedding t-SNE</h2>
+<div id="tsne" class="row"></div>
 <script>
 const DATA = {data};
 function svgLine(pts, w, h, color) {{
@@ -98,15 +105,134 @@ let hh = '';
 for (const [name, hist] of Object.entries(last.param_histograms || {{}}).slice(0, 24))
   hh += bars(name, hist);
 document.getElementById('hists').innerHTML = hh || '<p class="meta">none collected</p>';
+function actGrid(name, ch) {{
+  // one channel: rows x cols intensity grid (TrainModule activations view)
+  const g = ch.grid, rows = g.length, cols = g[0].length, cell = 6;
+  const w = cols * cell + 2, h = rows * cell + 16;
+  let mn = Infinity, mx = -Infinity;
+  g.forEach(r => r.forEach(v => {{ mn = Math.min(mn, v); mx = Math.max(mx, v); }}));
+  let rects = '';
+  for (let r = 0; r < rows; r++) for (let c = 0; c < cols; c++) {{
+    const t = mx === mn ? 0 : (g[r][c] - mn) / (mx - mn);
+    const lum = Math.round(255 * t);
+    rects += `<rect x="${{c * cell}}" y="${{r * cell}}" width="${{cell}}"` +
+      ` height="${{cell}}" fill="rgb(${{lum}},${{lum}},${{lum}})"/>`;
+  }}
+  return `<svg width="${{w}}" height="${{h}}">${{rects}}` +
+    `<text class="lbl" x="${{w / 2}}" y="${{h - 3}}">${{name}}</text></svg>`;
+}}
+let ah = '';
+for (const layer of (DATA.activations || [])) {{
+  ah += `<div class="chart"><div class="meta">${{layer.name}} ` +
+    `${{JSON.stringify(layer.shape)}}</div>`;
+  layer.channels.forEach((ch, i) => {{ ah += actGrid('ch' + ch.index, ch); }});
+  ah += '</div>';
+}}
+document.getElementById('acts').innerHTML = ah || '<p class="meta">none collected</p>';
+const emb = DATA.embedding;
+if (emb && emb.points.length) {{
+  const w = 480, h = 420;
+  const xs = emb.points.map(p => p[0]), ys = emb.points.map(p => p[1]);
+  const x0 = Math.min(...xs), x1 = Math.max(...xs);
+  const y0 = Math.min(...ys), y1 = Math.max(...ys);
+  const palette = ['#c0392b','#27ae60','#2c6fad','#8e44ad','#f39c12',
+                   '#16a085','#d35400','#7f8c8d','#2c3e50','#e84393'];
+  let dots = '';
+  emb.points.forEach((pt, i) => {{
+    const sx = 10 + (w - 20) * (x1 === x0 ? 0.5 : (pt[0] - x0) / (x1 - x0));
+    const sy = 10 + (h - 40) * (y1 === y0 ? 0.5 : (pt[1] - y0) / (y1 - y0));
+    const lab = (emb.labels || [])[i];
+    const col = lab == null ? '#2c6fad' : palette[Math.abs(lab) % palette.length];
+    dots += `<circle cx="${{sx.toFixed(1)}}" cy="${{sy.toFixed(1)}}" r="2.5"` +
+      ` fill="${{col}}" fill-opacity="0.7"/>`;
+  }});
+  document.getElementById('tsne').innerHTML =
+    `<div class="chart"><svg width="${{w}}" height="${{h}}">${{dots}}` +
+    `<text class="lbl" x="${{w / 2}}" y="${{h - 6}}">` +
+    `${{emb.points.length}} points (kl=${{emb.kl}})</text></svg></div>`;
+}} else {{
+  document.getElementById('tsne').innerHTML = '<p class="meta">none collected</p>';
+}}
 </script>
 </body></html>
 """
 
 
+def collect_conv_activations(net, x, max_layers: int = 6,
+                             max_channels: int = 8, max_hw: int = 14):
+    """Per-conv-layer activation grids for a sample batch (the
+    TrainModule activations view's data): runs net.feed_forward on
+    x[:1] and average-pools each 4-D activation down to <= max_hw per
+    side, keeping the first max_channels channels. Returns the
+    `activations` structure render_html embeds."""
+    import numpy as np
+
+    acts = net.feed_forward(x[:1])
+    layer_names = [type(l).__name__ for l in net.conf.layers]
+    out = []
+    for i, a in enumerate(acts[1:]):
+        a = np.asarray(a)
+        if a.ndim != 4:       # NHWC conv outputs only
+            continue
+        _, h, w, c = a.shape
+        sh = max(1, -(-h // max_hw))
+        sw = max(1, -(-w // max_hw))
+        hp, wp = -(-h // sh) * sh, -(-w // sw) * sw
+        padded = np.zeros((hp, wp, c), np.float64)
+        padded[:h, :w] = a[0]
+        valid = np.zeros((hp, wp, 1), np.float64)
+        valid[:h, :w] = 1.0
+        sums = padded.reshape(hp // sh, sh, wp // sw, sw, c).sum((1, 3))
+        counts = valid.reshape(hp // sh, sh, wp // sw, sw, 1).sum((1, 3))
+        pooled = sums / np.maximum(counts, 1.0)
+        channels = [{"index": int(ci),
+                     "grid": np.round(pooled[:, :, ci], 4).tolist()}
+                    for ci in range(min(c, max_channels))]
+        out.append({"name": f"{i}:{layer_names[i]}",
+                    "shape": [int(h), int(w), int(c)],
+                    "channels": channels})
+        if len(out) >= max_layers:
+            break
+    return out
+
+
+def embedding_scatter(vectors, labels=None, perplexity: float = 20.0,
+                      max_points: int = 2000, max_iter: int = 300,
+                      seed: int = 0):
+    """2-D t-SNE of an embedding matrix for the dashboard's t-SNE tab
+    (ref ui/module/tsne/): subsamples to max_points, runs
+    clustering.Tsne (auto tier), returns the `embedding` structure
+    render_html embeds."""
+    import numpy as np
+
+    from deeplearning4j_tpu.clustering.tsne import Tsne
+
+    vectors = np.asarray(vectors, np.float32)
+    n = vectors.shape[0]
+    if n < 8:        # too few points for any valid perplexity
+        return {"points": [], "labels": None, "kl": None}
+    if n > max_points:
+        sel = np.random.default_rng(seed).choice(n, max_points,
+                                                 replace=False)
+        vectors = vectors[sel]
+        labels = None if labels is None else np.asarray(labels)[sel]
+    # keep within Tsne's n-1 >= 3*perplexity guard
+    perplexity = min(perplexity, (vectors.shape[0] - 1) / 3.0)
+    t = Tsne(perplexity=perplexity, max_iter=max_iter, seed=seed)
+    pts = t.fit_transform(vectors)
+    return {"points": np.round(pts, 3).tolist(),
+            "labels": None if labels is None
+            else [int(v) for v in labels],
+            "kl": round(t.kl_, 4) if t.kl_ is not None else None}
+
+
 def render_html(storage: StatsStorage, session_id: Optional[str] = None,
-                path: Optional[str] = None) -> str:
+                path: Optional[str] = None, activations=None,
+                embedding=None) -> str:
     """Render a self-contained HTML report; write to `path` if given.
-    Defaults to the storage's only (or first) session."""
+    Defaults to the storage's only (or first) session. `activations`
+    (collect_conv_activations) and `embedding` (embedding_scatter) fill
+    the conv-activation and t-SNE tabs."""
     sessions = storage.session_ids()
     if not sessions:
         raise ValueError("storage has no sessions")
@@ -124,7 +250,9 @@ def render_html(storage: StatsStorage, session_id: Optional[str] = None,
         etl=fmt(latest.etl_ms if latest else None, 2),
         dev_mem=fmt((latest.mem or {}).get("device_in_use_mb")
                     if latest else None),
-        data=json.dumps({"reports": [r.to_dict() for r in reports]}),
+        data=json.dumps({"reports": [r.to_dict() for r in reports],
+                         "activations": activations,
+                         "embedding": embedding}),
     )
     if path:
         with open(path, "w") as f:

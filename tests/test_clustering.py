@@ -151,3 +151,47 @@ def test_nearest_neighbors_server_client(rng):
         assert len(batch) == 3 and len(batch[0]["indices"]) == 2
     finally:
         server.stop()
+
+
+def test_tsne_chunked_matches_exact(rng):
+    """The streamed tier (BarnesHutTsne.java role) reproduces the exact
+    tier's embedding quality on an overlap-sized problem: similar KL
+    and the same cluster structure."""
+    n_per = 200
+    centers = np.array([[8, 0, 0], [0, 8, 0], [0, 0, 8]], np.float32)
+    x = np.concatenate(
+        [rng.normal(size=(n_per, 3)).astype(np.float32) + c
+         for c in centers])
+    labels = np.repeat(np.arange(3), n_per)
+
+    def sep(y):
+        cents = np.stack([y[labels == i].mean(0) for i in range(3)])
+        intra = np.mean(
+            [np.linalg.norm(y[labels == i] - cents[i], axis=1).mean()
+             for i in range(3)])
+        inter = np.mean([np.linalg.norm(cents[i] - cents[j])
+                         for i in range(3) for j in range(i + 1, 3)])
+        return intra / inter
+
+    kls = {}
+    for method in ("exact", "chunked"):
+        t = Tsne(perplexity=20, max_iter=150, seed=3, method=method,
+                 row_block=128)
+        y = t.fit_transform(x)
+        assert sep(y) < 0.5, f"{method} failed to separate clusters"
+        kls[method] = t.kl_
+    # chunked P is KNN-sparse, exact is dense: KLs agree to ~15%
+    assert abs(kls["chunked"] - kls["exact"]) < 0.15 * kls["exact"] + 0.1
+
+
+def test_tsne_chunked_padding_and_method_guard(rng):
+    """row_block that doesn't divide N exercises the sentinel-row
+    padding; bad method names raise."""
+    x = rng.normal(size=(300, 4)).astype(np.float32)
+    t = Tsne(perplexity=10, max_iter=60, seed=1, method="chunked",
+             row_block=128)   # pads 300 -> 384
+    y = t.fit_transform(x)
+    assert y.shape == (300, 2) and np.all(np.isfinite(y))
+    assert np.isfinite(t.kl_)
+    with pytest.raises(ValueError):
+        Tsne(method="dense")

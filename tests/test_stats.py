@@ -164,3 +164,55 @@ def test_remote_router_is_write_only():
     r = RemoteStatsStorageRouter("http://127.0.0.1:1/")
     with pytest.raises(NotImplementedError):
         r.session_ids()
+
+
+def test_dashboard_conv_activations_and_tsne_tabs(rng):
+    """Conv-activation grids + embedding t-SNE tab render from a real
+    small-CNN run (TrainModule activations view + ui/module/tsne
+    roles)."""
+    from deeplearning4j_tpu.nn.conf import InputType
+    from deeplearning4j_tpu.nn.layers import (
+        ConvolutionLayer,
+        DenseLayer,
+        OutputLayer,
+        SubsamplingLayer,
+    )
+    from deeplearning4j_tpu.stats import (
+        InMemoryStatsStorage,
+        StatsListener,
+        collect_conv_activations,
+        embedding_scatter,
+        render_html,
+    )
+
+    x = rng.normal(size=(96, 10, 10, 1)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 96)]
+    conf = (NeuralNetConfiguration.Builder().seed(1).updater("adam")
+            .learning_rate(1e-3).activation("relu").weight_init("xavier")
+            .list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3)))
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=2, loss="mcxent"))
+            .set_input_type(InputType.convolutional(10, 10, 1)).build())
+    storage = InMemoryStatsStorage()
+    net = MultiLayerNetwork(conf).init()
+    net.listeners.append(StatsListener(storage, frequency=1))
+    net.fit([(x, y)] * 3)
+
+    acts = collect_conv_activations(net, x)
+    assert acts and acts[0]["name"].endswith("ConvolutionLayer")
+    assert acts[0]["shape"][2] == 4            # channels recorded
+    assert len(acts[0]["channels"][0]["grid"]) <= 14
+
+    penult = np.asarray(net.feed_forward(x)[-2])
+    emb = embedding_scatter(penult, labels=np.argmax(y, 1),
+                            perplexity=10, max_iter=60)
+    assert len(emb["points"]) == 96 and len(emb["points"][0]) == 2
+    assert emb["kl"] is not None and np.isfinite(emb["kl"])
+
+    page = render_html(storage, activations=acts, embedding=emb)
+    assert "Convolutional activations" in page
+    assert "Embedding t-SNE" in page
+    assert '"activations": [{"name": "0:ConvolutionLayer"' in page
+    assert '"embedding": {"points"' in page
