@@ -249,7 +249,8 @@ def main():
         }))
         return
     if len(sys.argv) > 1 and sys.argv[1] == "lstm":
-        tps, step_s, loss = bench_lstm()
+        b = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+        tps, step_s, loss = bench_lstm(batch=b)
         print(json.dumps({
             "metric": "lstm_char_rnn_tokens_per_sec_per_chip",
             "value": round(tps, 1),
@@ -257,7 +258,7 @@ def main():
             "vs_baseline": 1.0,
             "step_time_ms": round(step_s * 1e3, 1),
             "final_loss": round(loss, 3),
-            "config": "batch=64 seq=256 vocab=98 2xLSTM(256)",
+            "config": f"batch={b} seq=256 vocab=98 2xLSTM(256)",
             "device": str(dev.device_kind),
             "platform": str(dev.platform),
             "jax": jax.__version__,

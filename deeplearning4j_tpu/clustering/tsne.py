@@ -180,9 +180,12 @@ def _chunked_step(y, idx, psym, mutual, exaggeration, row_block: int,
 
 
 class Tsne:
-    """ref: BarnesHutTsne builder — nDims, perplexity, theta (ignored:
-    exact mode), learningRate, maxIter, momentum schedule, early
-    exaggeration (stopLyingIteration)."""
+    """ref: BarnesHutTsne builder — nDims, perplexity, theta (accepted
+    for parity, ignored: both tiers are exact in the repulsive term),
+    learningRate, maxIter, momentum schedule, early exaggeration
+    (stopLyingIteration). `method` picks the tier ('auto' streams
+    above DENSE_CAP points); `row_block` sizes the streamed tier's
+    [row_block, N] kernel blocks (memory/speed trade)."""
 
     # dense-tier cap: above this fit_transform streams (method='auto')
     DENSE_CAP = 16384
@@ -210,6 +213,8 @@ class Tsne:
                 f"method must be auto|exact|chunked: {method}")
         self.method = method
         self.row_block = int(row_block)
+        if self.row_block < 1:
+            raise ValueError(f"row_block must be >= 1: {row_block}")
         self.kl_: Optional[float] = None
 
     def fit_transform(self, x) -> np.ndarray:

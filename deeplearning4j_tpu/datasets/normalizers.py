@@ -112,3 +112,27 @@ class ImagePreProcessingScaler(Normalizer):
     def transform(self, ds):
         ds.features = (ds.features / self.max_pixel) * (self.b - self.a) + self.a
         return ds
+
+
+@register
+class VGG16ImagePreProcessor(Normalizer):
+    """ImageNet mean subtraction for VGG16-family inputs (ref
+    TrainedModels.VGG16.getPreProcessor /
+    VGG16ImagePreProcessor.java): subtracts the per-channel dataset
+    mean, no scaling. Channel order follows the tensor's last axis
+    (NHWC RGB by default, matching the importer's layout)."""
+
+    MEAN_RGB = (123.68, 116.779, 103.939)
+
+    def __init__(self, mean=None):
+        import numpy as _np
+
+        self.mean = _np.asarray(
+            self.MEAN_RGB if mean is None else mean, _np.float32)
+
+    def fit(self, data):
+        return self
+
+    def transform(self, ds):
+        ds.features = ds.features - self.mean
+        return ds

@@ -220,9 +220,14 @@ def embedding_scatter(vectors, labels=None, perplexity: float = 20.0,
     perplexity = min(perplexity, (vectors.shape[0] - 1) / 3.0)
     t = Tsne(perplexity=perplexity, max_iter=max_iter, seed=seed)
     pts = t.fit_transform(vectors)
+    if labels is None:
+        lab_idx = None
+    else:
+        # palette indices for ANY label type (ints, strings, ...)
+        uniq = {v: i for i, v in enumerate(dict.fromkeys(labels))}
+        lab_idx = [uniq[v] for v in labels]
     return {"points": np.round(pts, 3).tolist(),
-            "labels": None if labels is None
-            else [int(v) for v in labels],
+            "labels": lab_idx,
             "kl": round(t.kl_, 4) if t.kl_ is not None else None}
 
 
