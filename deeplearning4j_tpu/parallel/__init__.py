@@ -18,5 +18,9 @@ from deeplearning4j_tpu.parallel.sharding import (  # noqa: F401
     param_shardings,
     replicated,
 )
-from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper  # noqa: F401
+from deeplearning4j_tpu.parallel.wrapper import (  # noqa: F401
+    LocalStepTrainer,
+    ParallelWrapper,
+    StaleGradientTrainer,
+)
 from deeplearning4j_tpu.parallel.inference import ParallelInference  # noqa: F401

@@ -118,40 +118,15 @@ class ParallelWrapper:
         self._sharded = True
 
     def _pad_batch(self, x):
-        """Pad the batch dim up to a multiple of dp (static shapes for XLA).
-
-        Returns (padded, pad_count). Label masks handle the padding rows'
-        contribution (they're zero-masked)."""
-        b = x.shape[0]
+        b = np.asarray(x).shape[0]
         rem = (-b) % self.dp
         if rem == 0:
-            return x, 0
+            return np.asarray(x), 0
         pad = np.zeros((rem,) + tuple(x.shape[1:]), x.dtype)
         return np.concatenate([np.asarray(x), pad], axis=0), rem
 
     def _pad_with_masks(self, x, y, fm, lm):
-        """Pad one batch's leading dim to a dp multiple, masking padded
-        rows out of the loss. Returns (x, y, fm, lm)."""
-        x, npad = self._pad_batch(np.asarray(x))
-        if npad:
-            y2 = np.asarray(y)
-            ypad = np.zeros((npad,) + y2.shape[1:], y2.dtype)
-            y = np.concatenate([y2, ypad], 0)
-            # mask padding rows out of the loss
-            if lm is None:
-                lm = np.ones(
-                    (x.shape[0],) if y2.ndim == 2
-                    else (x.shape[0], y2.shape[1]), np.float32)
-                lm[-npad:] = 0.0
-            else:
-                lm2 = np.asarray(lm)
-                lm = np.concatenate(
-                    [lm2, np.zeros((npad,) + lm2.shape[1:], lm2.dtype)], 0)
-            if fm is not None:
-                fm2 = np.asarray(fm)
-                fm = np.concatenate(
-                    [fm2, np.zeros((npad,) + fm2.shape[1:], fm2.dtype)], 0)
-        return x, y, fm, lm
+        return _pad_batch_with_masks(self.dp, x, y, fm, lm)
 
     # ------------------------------------------------------------------
     def fit(self, data, epochs: int = 1):
@@ -269,6 +244,86 @@ def _as_batch(batch):
     return f(batch)
 
 
+def _pad_batch_with_masks(dp, x, y, fm, lm):
+    """Pad one batch's leading dim to a dp multiple (static shapes for
+    XLA), masking padded rows out of the loss. Returns (x, y, fm, lm).
+    Shared by ParallelWrapper and StaleGradientTrainer."""
+    x = np.asarray(x)
+    npad = (-x.shape[0]) % dp
+    if npad:
+        x = np.concatenate(
+            [x, np.zeros((npad,) + x.shape[1:], x.dtype)], 0)
+        y2 = np.asarray(y)
+        y = np.concatenate(
+            [y2, np.zeros((npad,) + y2.shape[1:], y2.dtype)], 0)
+        if lm is None:
+            lm = np.ones(
+                (x.shape[0],) if y2.ndim == 2
+                else (x.shape[0], y2.shape[1]), np.float32)
+            lm[-npad:] = 0.0
+        else:
+            lm2 = np.asarray(lm)
+            lm = np.concatenate(
+                [lm2, np.zeros((npad,) + lm2.shape[1:], lm2.dtype)], 0)
+        if fm is not None:
+            fm2 = np.asarray(fm)
+            fm = np.concatenate(
+                [fm2, np.zeros((npad,) + fm2.shape[1:], fm2.dtype)], 0)
+    return x, y, fm, lm
+
+
+def _make_loss_and_apply(net):
+    """(loss_for_grad, apply_updates) closures over a net — shared by
+    the local-SGD and stale-gradient trainers."""
+    conf = net.conf
+    cd = net.compute_dtype
+    is_graph = hasattr(conf, "network_inputs")
+
+    def loss_for_grad(params, states, x, y, rng, fm, lm):
+        if cd is not None:
+            from deeplearning4j_tpu.nn.dtype import cast_floating
+            params = cast_floating(params, cd)
+            x = cast_floating(x, cd)
+        loss, (new_states, _) = net._loss_fn(
+            params, states, x, y, rng, fm, lm, rnn_carries=None)
+        if cd is not None:
+            loss = loss.astype(net.dtype)
+        return loss, new_states
+
+    if is_graph:
+        layer_names = [n.name for n in net.topo if n.kind == "layer"]
+        frozen = {n.name for n in net.topo
+                  if n.kind == "layer" and n.obj.frozen}
+        lr_factors = {
+            n.name: ((n.obj.learning_rate / conf.learning_rate)
+                     if getattr(n.obj, "learning_rate", None) is not None
+                     and conf.learning_rate != 0 else 1.0)
+            for n in net.topo if n.kind == "layer"}
+
+        def apply_updates(params, upd_states, grads, lr, step):
+            from deeplearning4j_tpu.nn.updater import fused_apply
+            np_list, nu_list = fused_apply(
+                [(net._updaters[name], lr_factors[name], name in frozen,
+                  params[name], grads[name], upd_states[name])
+                 for name in layer_names], lr, step)
+            return (dict(zip(layer_names, np_list)),
+                    dict(zip(layer_names, nu_list)))
+    else:
+        lr_factors = [
+            (l.learning_rate / conf.learning_rate)
+            if l.learning_rate is not None and conf.learning_rate != 0
+            else 1.0 for l in conf.layers]
+
+        def apply_updates(params, upd_states, grads, lr, step):
+            from deeplearning4j_tpu.nn.updater import fused_apply
+            return fused_apply(
+                [(net._updaters[i], lr_factors[i], conf.layers[i].frozen,
+                  params[i], grads[i], upd_states[i])
+                 for i in range(len(params))], lr, step)
+
+    return loss_for_grad, apply_updates
+
+
 class LocalStepTrainer:
     """True `averagingFrequency=k` local-SGD semantics via shard_map:
     each dp shard carries its own params for k local steps (gradients of
@@ -322,50 +377,7 @@ class LocalStepTrainer:
         net = self.net
         conf = net.conf
         avg_upd = self.average_updaters
-        is_graph = hasattr(conf, "network_inputs")
-        cd = net.compute_dtype
-
-        def loss_for_grad(params, states, x, y, rng, fm, lm):
-            if cd is not None:
-                from deeplearning4j_tpu.nn.dtype import cast_floating
-                params = cast_floating(params, cd)
-                x = cast_floating(x, cd)
-            loss, (new_states, _) = net._loss_fn(
-                params, states, x, y, rng, fm, lm, rnn_carries=None)
-            if cd is not None:
-                loss = loss.astype(net.dtype)
-            return loss, new_states
-
-        if is_graph:
-            layer_names = [n.name for n in net.topo if n.kind == "layer"]
-            frozen = {n.name for n in net.topo
-                      if n.kind == "layer" and n.obj.frozen}
-            lr_factors = {
-                n.name: ((n.obj.learning_rate / conf.learning_rate)
-                         if getattr(n.obj, "learning_rate", None) is not None
-                         and conf.learning_rate != 0 else 1.0)
-                for n in net.topo if n.kind == "layer"}
-
-            def apply_updates(params, upd_states, grads, lr, step):
-                from deeplearning4j_tpu.nn.updater import fused_apply
-                np_list, nu_list = fused_apply(
-                    [(net._updaters[name], lr_factors[name], name in frozen,
-                      params[name], grads[name], upd_states[name])
-                     for name in layer_names], lr, step)
-                return (dict(zip(layer_names, np_list)),
-                        dict(zip(layer_names, nu_list)))
-        else:
-            lr_factors = [
-                (l.learning_rate / conf.learning_rate)
-                if l.learning_rate is not None and conf.learning_rate != 0
-                else 1.0 for l in conf.layers]
-
-            def apply_updates(params, upd_states, grads, lr, step):
-                from deeplearning4j_tpu.nn.updater import fused_apply
-                return fused_apply(
-                    [(net._updaters[i], lr_factors[i], conf.layers[i].frozen,
-                      params[i], grads[i], upd_states[i])
-                     for i in range(len(params))], lr, step)
+        loss_for_grad, apply_updates = _make_loss_and_apply(net)
 
         thr = self.threshold
 
@@ -601,3 +613,161 @@ class LocalStepTrainer:
         for listener in net.listeners:
             listener.iteration_done(net, net.iteration)
         return loss
+
+
+class StaleGradientTrainer:
+    """DP-4's async training DYNAMICS, TPU-natively (parity role:
+    SharedTrainingMaster.java:72 / SharedTrainingWrapper.java:196-240 —
+    workers there train on gradients that arrive late through the Aeron
+    parameter server).
+
+    SPMD redesign: bounded 1-step staleness instead of unbounded async.
+    Step t computes this batch's globally-averaged gradient g_t but
+    APPLIES g_{t-1}: the cross-slice all-reduce of g_t therefore sits
+    on the program's critical path BEHIND the next step's compute, so
+    XLA's async collectives can overlap it with forward/backward work —
+    the latency-hiding role of the reference's parameter server with a
+    hard staleness bound (and none of its lost-update races, SURVEY
+    §5.2). fit() flushes the final pending gradient so no update is
+    dropped; updater state (momentum etc.) advances with the DELAYED
+    gradient stream, matching how the reference's workers consume late
+    updates.
+
+    Constraints: tp == 1 (params replicated inside the shard_map), no
+    truncated BPTT.
+    """
+
+    def __init__(self, net, mesh: Mesh):
+        if mesh.shape["tp"] != 1:
+            raise NotImplementedError(
+                "StaleGradientTrainer requires tp == 1")
+        if getattr(net.conf, "backprop_type", None) == "truncated_bptt":
+            raise NotImplementedError(
+                "StaleGradientTrainer does not support truncated BPTT")
+        self.net = net
+        self.mesh = mesh
+        self._fn_cache = {}
+        self._pending = None     # g_{t-1}: replicated averaged gradient
+
+    def _build(self, with_fm: bool, with_lm: bool, flush: bool):
+        from deeplearning4j_tpu.nn.updater import schedule_lr
+
+        net = self.net
+        conf = net.conf
+        # rebuilt per cache entry: the frozen set is baked into these
+        # closures (cache is keyed on frozen_sig for that reason)
+        loss_for_grad, apply_updates = _make_loss_and_apply(net)
+
+        def worker(params, upd_states, states, prev_g, step, x, y, fm,
+                   lm, rng, lr_scale):
+            lr = schedule_lr(conf, step) * lr_scale
+            if flush:
+                # terminal half-step: apply the last pending gradient
+                params, upd_states = apply_updates(
+                    params, upd_states, prev_g, lr, step)
+                return (params, upd_states, states, prev_g,
+                        jnp.zeros(()))
+            rng = jax.random.fold_in(rng, jax.lax.axis_index("dp"))
+            (loss, new_states), grads = jax.value_and_grad(
+                loss_for_grad, has_aux=True)(
+                    params, states, x, y, rng, fm, lm)
+            grads = net._clip_grads(grads)
+            pmean = lambda t: jax.tree_util.tree_map(
+                lambda a: jax.lax.pmean(a, "dp"), t)
+            g_avg = pmean(grads)
+            # per-shard BN running stats must agree before the
+            # replicated out_spec (same contract as LocalStepTrainer)
+            new_states = pmean(new_states)
+            # apply the PREVIOUS step's gradient (1-step staleness)
+            params, upd_states = apply_updates(
+                params, upd_states, prev_g, lr, step)
+            return (params, upd_states, new_states, g_avg,
+                    jax.lax.pmean(loss, "dp"))
+
+        rep = P()
+        xspec = P("dp")
+        fspec = xspec if with_fm else rep
+        lspec = xspec if with_lm else rep
+        return jax.jit(jax.shard_map(
+            worker, mesh=self.mesh,
+            in_specs=(rep, rep, rep, rep, rep, xspec, xspec, fspec,
+                      lspec, rep, rep),
+            out_specs=(rep, rep, rep, rep, rep),
+            check_vma=False),
+            donate_argnums=(0, 1, 2, 3))
+
+    def _zero_grads(self):
+        return jax.tree_util.tree_map(jnp.zeros_like, self.net.params)
+
+    def _frozen_sig(self):
+        net = self.net
+        if hasattr(net.conf, "network_inputs"):
+            return tuple(sorted(n.name for n in net.topo
+                                if n.kind == "layer" and n.obj.frozen))
+        return tuple(i for i, l in enumerate(net.conf.layers)
+                     if l.frozen)
+
+    def step(self, x, y, fm=None, lm=None):
+        net = self.net
+        if self._pending is None:
+            self._pending = self._zero_grads()
+        key = (fm is not None, lm is not None, False,
+               self._frozen_sig())
+        if key not in self._fn_cache:
+            self._fn_cache[key] = self._build(key[0], key[1], False)
+        net._rng, sub = jax.random.split(net._rng)
+        (net.params, net.updater_states, net.states, self._pending,
+         loss) = self._fn_cache[key](
+            net.params, net.updater_states, net.states, self._pending,
+            jnp.asarray(net.iteration, jnp.int32), x, y, fm, lm, sub,
+            jnp.asarray(net._lr_score_factor, jnp.float32))
+        net.iteration += 1
+        net._score = loss
+        net._apply_score_decay(loss)
+        for listener in net.listeners:
+            listener.iteration_done(net, net.iteration)
+        return loss
+
+    def flush(self):
+        """Apply the final pending gradient (call at end of fit)."""
+        net = self.net
+        if self._pending is None:
+            return
+        key = (False, False, True, self._frozen_sig())
+        if key not in self._fn_cache:
+            self._fn_cache[key] = self._build(False, False, True)
+        dummy = jnp.zeros((self.mesh.shape["dp"], 1), net.dtype)
+        (net.params, net.updater_states, net.states, self._pending,
+         _) = self._fn_cache[key](
+            net.params, net.updater_states, net.states, self._pending,
+            jnp.asarray(net.iteration, jnp.int32), dummy, dummy, None,
+            None, jax.random.PRNGKey(0),
+            jnp.asarray(net._lr_score_factor, jnp.float32))
+        self._pending = None
+
+    def fit(self, batches):
+        """Train over an iterable of batches in any _as_batch shape
+        ((x, y), (x, y, fm, lm), DataSet, ...), flushing the last
+        pending gradient at the end. Leading dims are padded to a dp
+        multiple with loss-masked rows."""
+        net = self.net
+        dp = self.mesh.shape["dp"]
+        with self.mesh:
+            for batch in batches:
+                x, y, fm, lm = _as_batch(batch)
+                x, y, fm, lm = _pad_batch_with_masks(
+                    dp, np.asarray(x), np.asarray(y), fm, lm)
+                x = jnp.asarray(x, net.dtype)
+                y = jnp.asarray(y, net.dtype)
+                fm = None if fm is None else jnp.asarray(fm)
+                lm = None if lm is None else jnp.asarray(lm)
+                is_graph = hasattr(net.conf, "network_inputs")
+                if is_graph:
+                    name = net.conf.network_inputs[0]
+                    self.step({name: x}, [y],
+                              None if fm is None else {name: fm},
+                              None if lm is None else [lm])
+                else:
+                    self.step(x, y, fm, lm)
+            self.flush()
+        return self

@@ -9,6 +9,7 @@ from deeplearning4j_tpu.stats.listener import StatsListener  # noqa: F401
 from deeplearning4j_tpu.stats.dashboard import (  # noqa: F401
     UIServer,
     collect_conv_activations,
+    collect_network_flow,
     embedding_scatter,
     render_html,
 )

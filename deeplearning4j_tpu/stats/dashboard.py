@@ -41,6 +41,8 @@ _PAGE = """<!DOCTYPE html>
 <div id="umm" class="row"></div>
 <h2>Latest parameter histograms</h2>
 <div id="hists" class="row"></div>
+<h2>Network graph</h2>
+<div id="flow" class="row"></div>
 <h2>Convolutional activations</h2>
 <div id="acts" class="row"></div>
 <h2>Embedding t-SNE</h2>
@@ -105,6 +107,37 @@ let hh = '';
 for (const [name, hist] of Object.entries(last.param_histograms || {{}}).slice(0, 24))
   hh += bars(name, hist);
 document.getElementById('hists').innerHTML = hh || '<p class="meta">none collected</p>';
+const flow = DATA.flow;
+if (flow && flow.nodes.length) {{
+  const byDepth = {{}};
+  flow.nodes.forEach(n => (byDepth[n.depth] = byDepth[n.depth] || []).push(n));
+  const depths = Object.keys(byDepth).map(Number).sort((a, b) => a - b);
+  const colW = 180, rowH = 46;
+  const maxRows = Math.max(...depths.map(d => byDepth[d].length));
+  const w = depths.length * colW + 20, h = maxRows * rowH + 30;
+  const pos = {{}};
+  depths.forEach((d, di) => byDepth[d].forEach((n, ri) => {{
+    pos[n.name] = [20 + di * colW, 20 + ri * rowH];
+  }}));
+  let svg = '';
+  flow.edges.forEach(e => {{
+    const a = pos[e[0]], b = pos[e[1]];
+    if (a && b) svg += `<line x1="${{a[0] + 120}}" y1="${{a[1] + 14}}"` +
+      ` x2="${{b[0]}}" y2="${{b[1] + 14}}" stroke="#aaa"/>`;
+  }});
+  flow.nodes.forEach(n => {{
+    const [x, y] = pos[n.name];
+    svg += `<rect x="${{x}}" y="${{y}}" width="120" height="28" rx="5"` +
+      ` fill="${{n.params ? '#eaf1f8' : '#f4f4f4'}}" stroke="#7a9cc0"/>` +
+      `<text class="lbl" x="${{x + 60}}" y="${{y + 12}}">${{n.name.slice(0, 18)}}</text>` +
+      `<text class="lbl" x="${{x + 60}}" y="${{y + 24}}">${{n.type.slice(0, 16)}}` +
+      `${{n.params ? ' · ' + n.params.toLocaleString() : ''}}</text>`;
+  }});
+  document.getElementById('flow').innerHTML =
+    `<div class="chart" style="overflow-x:auto"><svg width="${{w}}" height="${{h}}">${{svg}}</svg></div>`;
+}} else {{
+  document.getElementById('flow').innerHTML = '<p class="meta">none collected</p>';
+}}
 function actGrid(name, ch) {{
   // one channel: rows x cols intensity grid (TrainModule activations view)
   const g = ch.grid, rows = g.length, cols = g[0].length, cell = 6;
@@ -196,6 +229,51 @@ def collect_conv_activations(net, x, max_layers: int = 6,
     return out
 
 
+def collect_network_flow(net):
+    """Topology data for the flow/network renderer tab (the reference
+    TrainModule's model-graph view): nodes (name, type, depth, param
+    count) + directed edges. Works for MultiLayerNetwork (a chain) and
+    ComputationGraph (the conf DAG)."""
+    import jax
+    import numpy as np
+
+    def n_params(tree):
+        return sum(int(np.prod(np.asarray(a).shape))
+                   for a in jax.tree_util.tree_leaves(tree))
+
+    nodes, edges = [], []
+    conf = net.conf
+    if hasattr(conf, "network_inputs"):      # ComputationGraph
+        depth_of = {}
+        for name in conf.network_inputs:
+            depth_of[name] = 0
+            nodes.append({"name": name, "type": "Input", "depth": 0,
+                          "params": 0})
+        for gn in conf.topological_order():
+            depth = max((depth_of.get(i, 0) for i in gn.inputs),
+                        default=0) + 1
+            depth_of[gn.name] = depth
+            kind = type(gn.obj).__name__
+            p = (n_params(net.params[gn.name])
+                 if net.params and gn.name in net.params else 0)
+            nodes.append({"name": gn.name, "type": str(kind),
+                          "depth": depth, "params": p})
+            for src in gn.inputs:
+                edges.append([src, gn.name])
+    else:                                    # MultiLayerNetwork chain
+        prev = "input"
+        nodes.append({"name": "input", "type": "Input", "depth": 0,
+                      "params": 0})
+        for i, layer in enumerate(conf.layers):
+            name = f"{i}:{type(layer).__name__}"
+            p = n_params(net.params[i]) if net.params else 0
+            nodes.append({"name": name, "type": type(layer).__name__,
+                          "depth": i + 1, "params": p})
+            edges.append([prev, name])
+            prev = name
+    return {"nodes": nodes, "edges": edges}
+
+
 def embedding_scatter(vectors, labels=None, perplexity: float = 20.0,
                       max_points: int = 2000, max_iter: int = 300,
                       seed: int = 0):
@@ -233,11 +311,12 @@ def embedding_scatter(vectors, labels=None, perplexity: float = 20.0,
 
 def render_html(storage: StatsStorage, session_id: Optional[str] = None,
                 path: Optional[str] = None, activations=None,
-                embedding=None) -> str:
+                embedding=None, flow=None) -> str:
     """Render a self-contained HTML report; write to `path` if given.
     Defaults to the storage's only (or first) session. `activations`
-    (collect_conv_activations) and `embedding` (embedding_scatter) fill
-    the conv-activation and t-SNE tabs."""
+    (collect_conv_activations), `embedding` (embedding_scatter) and
+    `flow` (collect_network_flow) fill the conv-activation, t-SNE and
+    network-graph tabs."""
     sessions = storage.session_ids()
     if not sessions:
         raise ValueError("storage has no sessions")
@@ -257,7 +336,8 @@ def render_html(storage: StatsStorage, session_id: Optional[str] = None,
                     if latest else None),
         data=json.dumps({"reports": [r.to_dict() for r in reports],
                          "activations": activations,
-                         "embedding": embedding}),
+                         "embedding": embedding,
+                         "flow": flow}),
     )
     if path:
         with open(path, "w") as f:

@@ -416,3 +416,93 @@ def test_threshold_compression_requires_local_sgd():
     with pytest.raises(ValueError):
         TrainingMaster(_net(), averaging_frequency=1,
                        threshold_compression=1e-3)
+
+
+def test_stale_gradient_trainer_dynamics(rng):
+    """DP-4's stale-gradient dynamics (SharedTrainingWrapper role):
+    1-step-delayed application is mesh-size invariant (dp=2 == dp=1 on
+    the same global batches), differs from synchronous DP, and still
+    converges; the flush applies the final pending gradient."""
+    from deeplearning4j_tpu.parallel.wrapper import StaleGradientTrainer
+
+    proj = rng.normal(size=(8, 4)).astype(np.float32)
+
+    def learnable(n=16):
+        x = rng.normal(size=(n, 8)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[np.argmax(x @ proj, axis=1)]
+        return x, y
+
+    batches = [learnable() for _ in range(24)]
+
+    def run_stale(dp):
+        net = _net()
+        StaleGradientTrainer(
+            net, make_mesh(dp=dp, devices=_cpu_devices(dp))).fit(batches)
+        return net
+
+    stale1, stale2 = run_stale(1), run_stale(2)
+    for a, b in zip(jax.tree_util.tree_leaves(stale1.params),
+                    jax.tree_util.tree_leaves(stale2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=1e-5)
+
+    sync = _net()
+    ParallelWrapper(sync, mesh=make_mesh(
+        dp=2, devices=_cpu_devices(2))).fit(batches)
+    diffs = [float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+             for a, b in zip(jax.tree_util.tree_leaves(sync.params),
+                             jax.tree_util.tree_leaves(stale2.params))]
+    assert max(diffs) > 1e-5, "stale dynamics must differ from sync"
+    assert float(stale2.score()) < 1.0     # still converges
+    assert float(sync.score()) < 1.0
+
+
+def test_stale_gradient_first_step_applies_nothing(rng):
+    """Step 1 computes g_0 but applies the zero pending gradient: with
+    plain SGD the params are unchanged until step 2 / flush."""
+    from deeplearning4j_tpu.parallel.wrapper import StaleGradientTrainer
+
+    net = _net()
+    before = jax.tree_util.tree_map(np.asarray, net.params)
+    tr = StaleGradientTrainer(
+        net, make_mesh(dp=2, devices=_cpu_devices(2)))
+    x, y = _data(rng, n=16)
+    with tr.mesh:
+        tr.step(jnp.asarray(x), jnp.asarray(y))
+    for a, b in zip(jax.tree_util.tree_leaves(before),
+                    jax.tree_util.tree_leaves(net.params)):
+        np.testing.assert_allclose(np.asarray(b), a, atol=1e-7)
+    with tr.mesh:
+        tr.flush()    # now g_0 lands
+    moved = [float(np.max(np.abs(np.asarray(b) - a)))
+             for a, b in zip(jax.tree_util.tree_leaves(before),
+                             jax.tree_util.tree_leaves(net.params))]
+    assert max(moved) > 1e-6
+
+
+def test_stale_gradient_bn_states_and_ragged_batch(rng):
+    """BN running stats stay shard-consistent (pmean'd) under the
+    stale trainer, and a non-dp-divisible batch is padded + masked."""
+    from deeplearning4j_tpu.nn.conf import InputType
+    from deeplearning4j_tpu.nn.layers import (
+        BatchNormalization,
+        DenseLayer,
+        OutputLayer,
+    )
+    from deeplearning4j_tpu.parallel.wrapper import StaleGradientTrainer
+
+    conf = (NeuralNetConfiguration.Builder().seed(7).updater("sgd")
+            .learning_rate(0.05).activation("tanh").weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=8))
+            .layer(BatchNormalization())
+            .layer(OutputLayer(n_out=4, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8)).build())
+    net = MultiLayerNetwork(conf).init()
+    tr = StaleGradientTrainer(
+        net, make_mesh(dp=2, devices=_cpu_devices(2)))
+    batches = [_data(rng, n=15) for _ in range(4)]   # 15 % 2 != 0
+    tr.fit(batches)
+    assert np.isfinite(float(net.score()))
+    for leaf in jax.tree_util.tree_leaves(net.states):
+        assert np.all(np.isfinite(np.asarray(leaf)))

@@ -211,8 +211,50 @@ def test_dashboard_conv_activations_and_tsne_tabs(rng):
     assert len(emb["points"]) == 96 and len(emb["points"][0]) == 2
     assert emb["kl"] is not None and np.isfinite(emb["kl"])
 
-    page = render_html(storage, activations=acts, embedding=emb)
+    from deeplearning4j_tpu.stats import collect_network_flow
+
+    flow = collect_network_flow(net)
+    assert [n["name"] for n in flow["nodes"]][0] == "input"
+    assert any(n["params"] > 0 for n in flow["nodes"])
+    assert ["input", "0:ConvolutionLayer"] in flow["edges"]
+
+    page = render_html(storage, activations=acts, embedding=emb,
+                       flow=flow)
     assert "Convolutional activations" in page
     assert "Embedding t-SNE" in page
+    assert "Network graph" in page
     assert '"activations": [{"name": "0:ConvolutionLayer"' in page
     assert '"embedding": {"points"' in page
+    assert '"flow": {"nodes"' in page
+
+
+def test_network_flow_graph_topology(rng):
+    """collect_network_flow on a ComputationGraph: DAG edges and depths
+    follow the conf topology (TrainModule model-graph view role)."""
+    from deeplearning4j_tpu.nn.conf import InputType
+    from deeplearning4j_tpu.nn.conf.graph_vertices import MergeVertex
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.stats import collect_network_flow
+
+    conf = (NeuralNetConfiguration.Builder().seed(1)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_out=8), "in")
+            .add_layer("d2", DenseLayer(n_out=8), "in")
+            .add_vertex("merge", MergeVertex(), "d1", "d2")
+            .add_layer("out", OutputLayer(n_out=2, loss="mcxent"),
+                       "merge")
+            .set_outputs("out")
+            .set_input_types(**{"in": InputType.feed_forward(4)})
+            .build())
+    net = ComputationGraph(conf).init()
+    flow = collect_network_flow(net)
+    names = {n["name"]: n for n in flow["nodes"]}
+    assert names["in"]["depth"] == 0
+    assert names["d1"]["depth"] == 1 and names["d2"]["depth"] == 1
+    assert names["merge"]["depth"] == 2
+    assert names["out"]["depth"] == 3
+    assert ["d1", "merge"] in flow["edges"]
+    assert ["merge", "out"] in flow["edges"]
+    assert names["d1"]["params"] == 4 * 8 + 8
