@@ -1,0 +1,168 @@
+"""Standalone NN utility functions — 1:1 surface parity for the
+reference's static util classes whose logic is otherwise inlined into
+layers/losses here.
+
+Parity: util/TimeSeriesUtils.java (:44 movingAverage, :58/:74 mask
+vector reshapes, :93/:105 2d<->3d), util/ConvolutionUtils.java (:50
+getOutputSize, :151/:167 same-mode paddings, :229 validation),
+util/MaskedReductionUtil.java (:29 maskedPoolingTimeSeries, :163
+maskedPoolingConvolution), util/MathUtils.java (movingAverage cousin).
+
+All functions are jit-safe jnp ops (static shapes in, arrays out) so
+they compose into compiled programs instead of being host helpers.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+
+
+# ------------------------------------------------------ TimeSeriesUtils
+def moving_average(x, n: int):
+    """Trailing moving average over the last axis, length L-n+1
+    (TimeSeriesUtils.movingAverage :44)."""
+    x = jnp.asarray(x)
+    c = jnp.cumsum(x, axis=-1)
+    first = c[..., n - 1:n]
+    rest = c[..., n:] - c[..., :-n]
+    return jnp.concatenate([first, rest], axis=-1) / n
+
+
+def reshape_time_series_mask_to_vector(mask):
+    """[B, T] -> [B*T, 1] time-major-in-batch flattening
+    (TimeSeriesUtils :58)."""
+    mask = jnp.asarray(mask)
+    return mask.reshape(-1, 1)
+
+
+def reshape_vector_to_time_series_mask(vec, minibatch: int):
+    """Inverse of reshape_time_series_mask_to_vector
+    (TimeSeriesUtils :74)."""
+    vec = jnp.asarray(vec)
+    return vec.reshape(minibatch, -1)
+
+
+def reshape_3d_to_2d(x):
+    """[B, T, C] activations -> [B*T, C] (TimeSeriesUtils :93; the
+    reference's f-order shuffle is a layout detail ND4J needs and XLA
+    doesn't)."""
+    x = jnp.asarray(x)
+    b, t, c = x.shape
+    return x.reshape(b * t, c)
+
+
+def reshape_2d_to_3d(x, minibatch: int):
+    """[B*T, C] -> [B, T, C] (TimeSeriesUtils :105)."""
+    x = jnp.asarray(x)
+    return x.reshape(minibatch, -1, x.shape[-1])
+
+
+def reverse_time_series(x, mask=None):
+    """Reverse along time; with a [B, T] mask, each sequence's VALID
+    prefix is reversed in place (padding stays at the tail) — the
+    bidirectional-RNN input transform."""
+    x = jnp.asarray(x)
+    if mask is None:
+        return x[:, ::-1]
+    mask = jnp.asarray(mask)
+    lengths = jnp.sum(mask > 0, axis=1).astype(jnp.int32)     # [B]
+    t = x.shape[1]
+    idx = jnp.arange(t)[None, :]                              # [1, T]
+    rev = lengths[:, None] - 1 - idx
+    src = jnp.where(rev >= 0, rev, idx)                       # [B, T]
+    return jnp.take_along_axis(
+        x, src[(...,) + (None,) * (x.ndim - 2)], axis=1)
+
+
+# ----------------------------------------------------- ConvolutionUtils
+def get_output_size(input_hw: Sequence[int], kernel: Sequence[int],
+                    strides: Sequence[int], padding: Sequence[int],
+                    same_mode: bool = False,
+                    dilation: Sequence[int] = (1, 1)) -> Tuple[int, int]:
+    """Spatial output size (ConvolutionUtils.getOutputSize :50).
+    same_mode: ceil(in/stride); else floor((in + 2p - k_eff)/s) + 1
+    with the reference's divisibility semantics relaxed to floor (the
+    'truncate' mode XLA uses)."""
+    validate_cnn_kernel_stride_padding(kernel, strides, padding)
+    out = []
+    for i in range(2):
+        k_eff = kernel[i] + (kernel[i] - 1) * (dilation[i] - 1)
+        if same_mode:
+            out.append(-(-input_hw[i] // strides[i]))
+        else:
+            span = input_hw[i] + 2 * padding[i] - k_eff
+            if span < 0:
+                raise ValueError(
+                    f"kernel {kernel[i]} (dilated {k_eff}) larger than "
+                    f"padded input {input_hw[i] + 2 * padding[i]} on "
+                    f"axis {i}")
+            out.append(span // strides[i] + 1)
+    return tuple(out)
+
+
+def get_same_mode_top_left_padding(out_size, in_size, kernel, strides):
+    """Asymmetric SAME padding, top/left share
+    (ConvolutionUtils.getSameModeTopLeftPadding :151)."""
+    return tuple(
+        max((out_size[i] - 1) * strides[i] + kernel[i] - in_size[i], 0)
+        // 2 for i in range(2))
+
+
+def get_same_mode_bottom_right_padding(out_size, in_size, kernel,
+                                       strides):
+    """Asymmetric SAME padding, bottom/right share
+    (ConvolutionUtils :167)."""
+    total = [max((out_size[i] - 1) * strides[i] + kernel[i]
+                 - in_size[i], 0) for i in range(2)]
+    tl = get_same_mode_top_left_padding(out_size, in_size, kernel,
+                                        strides)
+    return tuple(total[i] - tl[i] for i in range(2))
+
+
+def validate_cnn_kernel_stride_padding(kernel, strides, padding):
+    """ConvolutionUtils.validateCnnKernelStridePadding :229."""
+    for name, v, lo in (("kernel", kernel, 1), ("stride", strides, 1),
+                        ("padding", padding, 0)):
+        if len(v) != 2:
+            raise ValueError(f"{name} must have 2 elements: {v}")
+        if any(int(e) < lo for e in v):
+            raise ValueError(f"{name} values must be >= {lo}: {v}")
+
+
+# -------------------------------------------------- MaskedReductionUtil
+def masked_pooling_time_series(pooling_type: str, x, mask):
+    """[B, T, C] pooled over time under a [B, T] mask
+    (MaskedReductionUtil.maskedPoolingTimeSeries :29).
+    pooling_type: max | avg | sum | pnorm is not ported (unused by any
+    reference zoo model)."""
+    x = jnp.asarray(x)
+    m = jnp.asarray(mask)[:, :, None]
+    if pooling_type == "max":
+        neg = jnp.finfo(x.dtype).min
+        return jnp.max(jnp.where(m > 0, x, neg), axis=1)
+    if pooling_type == "sum":
+        return jnp.sum(x * m, axis=1)
+    if pooling_type == "avg":
+        return (jnp.sum(x * m, axis=1)
+                / jnp.maximum(jnp.sum(m, axis=1), 1.0))
+    raise ValueError(f"unknown pooling type '{pooling_type}' "
+                     "(known: max, avg, sum)")
+
+
+def masked_pooling_convolution(pooling_type: str, x, mask):
+    """[B, H, W, C] pooled over space under a [B, H, W] mask
+    (MaskedReductionUtil.maskedPoolingConvolution :163, NHWC here)."""
+    x = jnp.asarray(x)
+    m = jnp.asarray(mask)[:, :, :, None]
+    if pooling_type == "max":
+        neg = jnp.finfo(x.dtype).min
+        return jnp.max(jnp.where(m > 0, x, neg), axis=(1, 2))
+    if pooling_type == "sum":
+        return jnp.sum(x * m, axis=(1, 2))
+    if pooling_type == "avg":
+        return (jnp.sum(x * m, axis=(1, 2))
+                / jnp.maximum(jnp.sum(m, axis=(1, 2)), 1.0))
+    raise ValueError(f"unknown pooling type '{pooling_type}' "
+                     "(known: max, avg, sum)")

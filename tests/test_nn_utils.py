@@ -1,0 +1,93 @@
+"""Standalone util parity (TimeSeriesUtils / ConvolutionUtils /
+MaskedReductionUtil roles)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.util import (
+    get_output_size,
+    get_same_mode_bottom_right_padding,
+    get_same_mode_top_left_padding,
+    masked_pooling_convolution,
+    masked_pooling_time_series,
+    moving_average,
+    reshape_2d_to_3d,
+    reshape_3d_to_2d,
+    reshape_time_series_mask_to_vector,
+    reshape_vector_to_time_series_mask,
+    reverse_time_series,
+)
+
+
+def test_moving_average():
+    x = np.array([1.0, 2.0, 3.0, 4.0, 5.0])
+    np.testing.assert_allclose(moving_average(x, 3),
+                               [2.0, 3.0, 4.0])
+
+
+def test_mask_reshapes_round_trip():
+    m = np.arange(6, dtype=np.float32).reshape(2, 3)
+    v = reshape_time_series_mask_to_vector(m)
+    assert v.shape == (6, 1)
+    np.testing.assert_array_equal(
+        reshape_vector_to_time_series_mask(v, 2), m)
+
+
+def test_3d_2d_round_trip():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    np.testing.assert_array_equal(
+        reshape_2d_to_3d(reshape_3d_to_2d(x), 2), x)
+
+
+def test_reverse_time_series_masked():
+    x = np.asarray([[[1.], [2.], [3.], [0.]],
+                    [[5.], [6.], [7.], [8.]]], np.float32)
+    mask = np.asarray([[1, 1, 1, 0], [1, 1, 1, 1]], np.float32)
+    out = np.asarray(reverse_time_series(x, mask))
+    np.testing.assert_allclose(out[0, :, 0], [3, 2, 1, 0])  # pad stays
+    np.testing.assert_allclose(out[1, :, 0], [8, 7, 6, 5])
+
+
+def test_conv_output_size_truncate_and_same():
+    assert get_output_size((28, 28), (5, 5), (1, 1), (0, 0)) == (24, 24)
+    assert get_output_size((28, 28), (5, 5), (2, 2), (2, 2)) == (14, 14)
+    assert get_output_size((28, 28), (3, 3), (2, 2), (0, 0),
+                           same_mode=True) == (14, 14)
+    # dilation widens the effective kernel
+    assert get_output_size((28, 28), (3, 3), (1, 1), (0, 0),
+                           dilation=(2, 2)) == (24, 24)
+    with pytest.raises(ValueError):
+        get_output_size((4, 4), (7, 7), (1, 1), (0, 0))
+    with pytest.raises(ValueError):
+        get_output_size((8, 8), (0, 3), (1, 1), (0, 0))
+
+
+def test_same_mode_paddings():
+    out = get_output_size((7, 7), (3, 3), (2, 2), (0, 0),
+                          same_mode=True)
+    tl = get_same_mode_top_left_padding(out, (7, 7), (3, 3), (2, 2))
+    br = get_same_mode_bottom_right_padding(out, (7, 7), (3, 3), (2, 2))
+    # total padding makes the strided window tiling exact
+    for i in range(2):
+        assert (out[i] - 1) * 2 + 3 - 7 == tl[i] + br[i]
+
+
+@pytest.mark.parametrize("ptype", ["max", "avg", "sum"])
+def test_masked_pooling_time_series(ptype):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2, 5, 3)).astype(np.float32)
+    mask = np.asarray([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], np.float32)
+    out = np.asarray(masked_pooling_time_series(ptype, x, mask))
+    ref0 = {"max": x[0, :3].max(0), "avg": x[0, :3].mean(0),
+            "sum": x[0, :3].sum(0)}[ptype]
+    np.testing.assert_allclose(out[0], ref0, rtol=1e-6)
+
+
+def test_masked_pooling_convolution():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(1, 4, 4, 2)).astype(np.float32)
+    mask = np.zeros((1, 4, 4), np.float32)
+    mask[0, :2, :2] = 1.0
+    out = np.asarray(masked_pooling_convolution("avg", x, mask))
+    np.testing.assert_allclose(out[0], x[0, :2, :2].mean((0, 1)),
+                               rtol=1e-6)

@@ -506,3 +506,37 @@ def test_stale_gradient_bn_states_and_ragged_batch(rng):
     assert np.isfinite(float(net.score()))
     for leaf in jax.tree_util.tree_leaves(net.states):
         assert np.all(np.isfinite(np.asarray(leaf)))
+
+
+def test_dcn_crossover_model():
+    """The DCN scaling model (the in-repo answer to 'when does sync
+    over DCN stop scaling'): ResNet50-sized exchange on a 25 GB/s link
+    stops scaling around a handful of slices; local SGD, compression
+    and stale overlap each restore efficiency as designed."""
+    from deeplearning4j_tpu.parallel import (
+        DcnLink,
+        allreduce_ms,
+        crossover_report,
+        dcn_sweep,
+    )
+
+    params = 25.6e6 * 4          # ResNet50 f32 grads
+    step = 52.3                  # flagship b128 step (PERF.md)
+    r = crossover_report(params, step, n_slices=8,
+                         compression_ratio=0.26)   # measured ratio
+    # 2*(7/8)*102MB at 25GB/s ~ 7.2ms + latency -> sync is ~87%
+    assert 0.8 < r["sync_efficiency"] < 0.95
+    assert r["local_sgd_efficiency"] > r["sync_efficiency"]
+    assert (r["local_sgd_compressed_efficiency"]
+            >= r["local_sgd_efficiency"])
+    assert r["stale_overlap_efficiency"] == 1.0   # fully hidden
+    assert r["k_for_target"] >= 1
+
+    # a slow link (1 GB/s) pushes sync below target quickly
+    slow = dcn_sweep(params, step, [2, 4, 8, 16],
+                     link=DcnLink(bandwidth_gbps=1.0))
+    assert not slow[-1]["sync_scales"]
+    # exchange cost is monotone in slice count
+    ex = [s["exchange_ms"] for s in slow]
+    assert ex == sorted(ex)
+    assert allreduce_ms(params, 1, DcnLink()) == 0.0
