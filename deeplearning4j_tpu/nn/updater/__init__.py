@@ -172,11 +172,25 @@ def nadam(beta1: float = 0.9, beta2: float = 0.999, epsilon: float = 1e-8) -> Up
     return Updater(init, update, ("nadam", beta1, beta2, epsilon))
 
 
+_CUSTOM_UPDATERS = {}
+
+
+def register_updater(name: str, factory) -> None:
+    """Register a custom updater factory `factory(conf) -> Updater`
+    under `name` for use in configurations — the reference's
+    custom-IUpdater plugin contract (tested there at
+    nn/updater/custom/). Registered names win over builtins so a
+    project can also override one."""
+    _CUSTOM_UPDATERS[str(name).lower()] = factory
+
+
 def get_updater(name: str, conf=None) -> Updater:
     """Build an updater by name, pulling hyperparams from a
     MultiLayerConfiguration-like object when given."""
     n = str(name).lower()
     c = conf
+    if n in _CUSTOM_UPDATERS:
+        return _CUSTOM_UPDATERS[n](conf)
 
     def g(attr, default):
         # a conf attr of None means "unset, use this updater's own default"
@@ -204,7 +218,13 @@ def get_updater(name: str, conf=None) -> Updater:
     if n == "nadam":
         return nadam(beta1=g("beta1", 0.9), beta2=g("beta2", 0.999),
                      epsilon=g("epsilon", 1e-8))
-    raise ValueError(f"Unknown updater '{name}'")
+    raise ValueError(
+        f"Unknown updater '{name}'. Known: sgd, none, nesterovs, "
+        "adagrad, rmsprop, adadelta, adam, adamax, nadam"
+        + (f" + custom {sorted(_CUSTOM_UPDATERS)}"
+           if _CUSTOM_UPDATERS else "")
+        + ". Custom updaters register via "
+        "nn.updater.register_updater(name, factory).")
 
 
 # ---------------- LR schedules ----------------

@@ -32,9 +32,11 @@ from typing import Dict, Optional
 @dataclass
 class DcnLink:
     """Cross-slice interconnect spec. Defaults are a typical
-    data-center NIC: 25 GB/s effective per host, ~0.1 ms latency."""
+    data-center NIC: 25 gigaBYTES/s effective per host, ~0.1 ms
+    latency. (Field is GB/s, not Gbps — divide a NIC's line rate in
+    gigabits by 8.)"""
 
-    bandwidth_gbps: float = 25.0
+    bandwidth_gbps: float = 25.0    # gigabytes per second
     latency_ms: float = 0.1
 
 
@@ -82,7 +84,9 @@ def crossover_report(param_bytes: float, step_ms: float,
     k_needed = 1
     while (efficiency(step_ms, ex, period_steps=k_needed)
            < target_efficiency and k_needed < 4096):
-        k_needed *= 2
+        k_needed += 1
+    target_reachable = (efficiency(step_ms, ex, period_steps=k_needed)
+                        >= target_efficiency)
 
     return {
         "exchange_ms": ex,
@@ -94,7 +98,8 @@ def crossover_report(param_bytes: float, step_ms: float,
         "local_sgd_efficiency": local_eff,
         "local_sgd_compressed_efficiency": comp_eff,
         "stale_overlap_efficiency": stale_eff,
-        "k_for_target": k_needed,
+        "k_for_target": k_needed if target_reachable else None,
+        "target_reachable": target_reachable,
         "target_efficiency": target_efficiency,
     }
 

@@ -350,7 +350,11 @@ class LocalStepTrainer:
         |delta+residual| >= threshold and keeps the remainder in a
         per-shard residual accumulator, so successive rendezvous
         eventually deliver everything. `wire_stats()` reports the
-        resulting bytes-on-wire vs a dense exchange."""
+        resulting bytes-on-wire vs a dense exchange. The residual is
+        in-memory state: a killed-and-resumed job loses its pending
+        (sub-threshold) delta mass, exactly like the reference's
+        in-memory residual accumulator — checkpoints capture the
+        delivered params only."""
         if mesh.shape["tp"] != 1:
             raise NotImplementedError(
                 "averaging_frequency > 1 requires tp == 1 (local-SGD "

@@ -56,6 +56,8 @@ def main():
     ap.add_argument("out_dir")
     ap.add_argument("--stop-after", type=int, default=0)
     ap.add_argument("--checkpoint-every", type=int, default=0)
+    ap.add_argument("--averaging-frequency", type=int, default=1)
+    ap.add_argument("--threshold-compression", type=float, default=0.0)
     args = ap.parse_args()
 
     from deeplearning4j_tpu.parallel.training_master import TrainingMaster
@@ -69,8 +71,11 @@ def main():
     net = build_net()
     ckpt = (os.path.join(args.out_dir, "ckpt")
             if args.checkpoint_every else None)
-    tm = TrainingMaster(net, checkpoint_dir=ckpt,
-                        checkpoint_every=args.checkpoint_every)
+    tm = TrainingMaster(
+        net, checkpoint_dir=ckpt,
+        checkpoint_every=args.checkpoint_every,
+        averaging_frequency=args.averaging_frequency,
+        threshold_compression=args.threshold_compression)
 
     def batch_fn(step):
         x, y = global_batch(step)
@@ -90,9 +95,14 @@ def main():
     if jax.process_index() == 0:
         leaves = [TrainingMaster._host_leaf(l)
                   for l in jax.tree_util.tree_leaves(net.params)]
+        extras = {"score": float(net.score()),
+                  "iteration": net.iteration}
+        if args.threshold_compression > 0.0:
+            wire = tm.training_stats()["wire"]
+            extras["wire_ratio"] = wire["compression_ratio"]
+            extras["wire_rendezvous"] = wire["rendezvous"]
         np.savez(os.path.join(args.out_dir, "final_params.npz"),
-                 *leaves, score=float(net.score()),
-                 iteration=net.iteration)
+                 *leaves, **extras)
     print(f"pid={args.pid} done score={float(net.score()):.5f}",
           flush=True)
 
