@@ -266,6 +266,27 @@ class _CbowHierarchicSoftmaxStep:
                         mask, lr)
 
 
+_DUP_CAP = 8.0
+
+
+def _dedup_scatter_add(table, idx_flat, rows):
+    """table[idx] += capped-sum-of-duplicates(rows): rows with up to
+    _DUP_CAP in-batch occurrences apply their full summed gradient
+    (approximating the sequential hogwild's total movement); beyond
+    that the sum is rescaled to the cap. A plain summed scatter
+    multiplies the head word's effective lr by its duplicate count —
+    under a zipf vocabulary that is thousands per batch and the table
+    NaNs within an epoch; a plain mean starves moderate-frequency
+    words of their sequential-equivalent step size."""
+    import jax.numpy as jnp
+
+    counts = jnp.zeros((table.shape[0],), rows.dtype).at[idx_flat].add(
+        1.0)
+    acc = jnp.zeros_like(table).at[idx_flat].add(rows)
+    scale = _DUP_CAP / jnp.maximum(counts, _DUP_CAP)
+    return table + acc * scale[:, None]
+
+
 class _DenseSteps:
     """Dense batched updates for large vocabularies (SURVEY §7 step 9 —
     the role of the reference's native AggregateSkipGram op behind
@@ -273,11 +294,12 @@ class _DenseSteps:
 
     Differences from the scan tier above, chosen for throughput:
 
-    - One batched update per batch of B pairs — in-batch duplicates sum
-      their gradients at the same table values (i.e. plain minibatch
-      SGD) instead of chunk-sequential semantics. At large vocab the
-      duplicate rate is negligible; at small vocab the scan tier remains
-      the default (see SequenceVectors._ensure_steps).
+    - One batched update per batch of B pairs; in-batch duplicate rows
+      contribute the MEAN of their gradients (see _dedup_scatter_add —
+      a summed scatter multiplies the head words' effective lr by
+      their in-batch count and NaNs the table on zipf vocabularies).
+      At small vocab the chunk-sequential scan tier remains the
+      default (see SequenceVectors._ensure_steps).
     - The device step is pure gather -> VPU elementwise -> scatter-add:
       logits/grads are broadcast-multiply-reduce, NOT batched dot_general
       (a [B]-batched [1,D]x[D,K] dot pads each tiny matmul to an MXU
@@ -324,8 +346,8 @@ class _DenseSteps:
         g = jnp.where(ok, (lab - p) * lr, 0.0)
         dv = jnp.sum(g[:, :, None] * u, axis=1)
         du = (g[:, :, None] * v[:, None, :]).reshape(-1, D)
-        syn0 = syn0.at[cen].add(dv)
-        syn1neg = syn1neg.at[tgt.reshape(-1)].add(du)
+        syn0 = _dedup_scatter_add(syn0, cen, dv)
+        syn1neg = _dedup_scatter_add(syn1neg, tgt.reshape(-1), du)
         return syn0, syn1neg
 
     @staticmethod
@@ -343,8 +365,8 @@ class _DenseSteps:
         g = ((1.0 - cds) - p) * msk * lr
         dv = jnp.sum(g[:, :, None] * u, axis=1)
         du = (g[:, :, None] * v[:, None, :]).reshape(-1, D)
-        syn0 = syn0.at[cen].add(dv)
-        syn1 = syn1.at[pts.reshape(-1)].add(du)
+        syn0 = _dedup_scatter_add(syn0, cen, dv)
+        syn1 = _dedup_scatter_add(syn1, pts.reshape(-1), du)
         return syn0, syn1
 
     @staticmethod
@@ -372,9 +394,10 @@ class _DenseSteps:
         g = jnp.where(ok, (lab - p) * lr, 0.0)
         du = (g[:, :, None] * h[:, None, :]).reshape(-1, D)
         dh = jnp.sum(g[:, :, None] * u, axis=1)
-        syn1neg = syn1neg.at[tgt.reshape(-1)].add(du)
+        syn1neg = _dedup_scatter_add(syn1neg, tgt.reshape(-1), du)
         dctx = dh[:, None, :] * cm[:, :, None]
-        syn0 = syn0.at[cw.reshape(-1)].add(dctx.reshape(-1, D))
+        syn0 = _dedup_scatter_add(syn0, cw.reshape(-1),
+                                  dctx.reshape(-1, D))
         return syn0, syn1neg
 
     @staticmethod
@@ -400,9 +423,10 @@ class _DenseSteps:
         g = ((1.0 - cds) - p) * msk * lr
         du = (g[:, :, None] * h[:, None, :]).reshape(-1, D)
         dh = jnp.sum(g[:, :, None] * u, axis=1)
-        syn1 = syn1.at[pts.reshape(-1)].add(du)
+        syn1 = _dedup_scatter_add(syn1, pts.reshape(-1), du)
         dctx = dh[:, None, :] * cm[:, :, None]
-        syn0 = syn0.at[cw.reshape(-1)].add(dctx.reshape(-1, D))
+        syn0 = _dedup_scatter_add(syn0, cw.reshape(-1),
+                                  dctx.reshape(-1, D))
         return syn0, syn1
 
     # --------------------------------------------------- slab dispatch

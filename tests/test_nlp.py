@@ -214,11 +214,14 @@ def test_word2vec_dense_tier_semantic_clusters(mode):
            .use_hierarchic_softmax(mode.endswith("hs"))
            .elements_learning_algorithm(
                "CBOW" if mode.startswith("cbow") else "SkipGram")
-           .min_word_frequency(1).epochs(6).seed(1)
+           .min_word_frequency(1).epochs(10).seed(1)
            .mode("dense")
            .iterate(CollectionSentenceIterator(sents))
            .build())
-    w2v.dense_batch_size = 512     # small batches for the tiny corpus
+    # small batches for the tiny test vocab: large batches put every
+    # word in every batch (the duplicate-collapse regime that makes
+    # scan the small-vocab default)
+    w2v.dense_batch_size = 128
     w2v.fit()
     intra = np.mean([w2v.similarity("cat", "dog"),
                      w2v.similarity("cpu", "gpu")])
