@@ -205,9 +205,10 @@ def bench_word2vec(vocab=5000, n_words=2_000_000, dim=128, window=5,
     # under-synchronizes through the dev tunnel, see PERF.md)
     _ = float(np.asarray(sv._syn0_dev[0, 0]))
     dt = time.perf_counter() - t0
-    # semantic sanity: frequent words should have coherent neighbors
-    sim = sv.similarity("w0", "w1")
-    assert np.isfinite(sim)
+    # stability sanity: the whole table must be finite (a summed
+    # duplicate scatter NaN'd the zipf head words in an early build)
+    assert np.all(np.isfinite(sv.syn0)), "non-finite embeddings"
+    assert np.isfinite(sv.similarity("w0", "w1"))
     return n_words * epochs / dt, dt
 
 

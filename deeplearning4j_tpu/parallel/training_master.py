@@ -47,7 +47,8 @@ class TrainingMaster:
     def __init__(self, net, checkpoint_dir: Optional[str] = None,
                  checkpoint_every: int = 0, mesh=None,
                  averaging_frequency: int = 1,
-                 threshold_compression: float = 0.0):
+                 threshold_compression: float = 0.0,
+                 checkpoint_format: str = "npz"):
         """`averaging_frequency=k > 1` runs k-step local SGD between
         parameter rendezvous — each dp shard trains privately for k
         steps, then params (+ updater state) are averaged. This is the
@@ -63,9 +64,13 @@ class TrainingMaster:
         import jax
         from deeplearning4j_tpu.parallel.mesh import make_mesh
 
+        if checkpoint_format not in ("npz", "orbax"):
+            raise ValueError(
+                f"checkpoint_format must be npz|orbax: {checkpoint_format}")
         self.net = net
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = checkpoint_every
+        self.checkpoint_format = checkpoint_format
         if mesh is None:
             mesh = make_mesh(dp=len(jax.devices()))
         self.mesh = mesh
@@ -402,11 +407,20 @@ class TrainingMaster:
         return np.asarray(a)
 
     def save_checkpoint(self, step: int):
-        """Write {params, updater state, states, step, rng} — process 0
-        only (shared-FS model, ref ParameterAveragingTrainingMaster's
-        driver-side ownership)."""
+        """Write {params, updater state, states, step, rng}.
+
+        format="npz": process 0 gathers everything to host and writes
+        one atomic .npz (shared-FS model, ref
+        ParameterAveragingTrainingMaster's driver-side ownership) —
+        right for replicated dp training at this scale.
+        format="orbax": every process participates in an
+        orbax.checkpoint save (SURVEY §7's "orbax-style sharded
+        checkpoints for scale" — sharded arrays are written without
+        gathering to one host)."""
         import jax
 
+        if self.checkpoint_format == "orbax":
+            return self._save_orbax(step)
         if jax.process_index() != 0:
             return
         os.makedirs(self.checkpoint_dir, exist_ok=True)
@@ -429,6 +443,47 @@ class TrainingMaster:
         os.replace(os.path.join(self.checkpoint_dir, "latest.json.tmp"),
                    os.path.join(self.checkpoint_dir, "latest.json"))
 
+    def _orbax_path(self, step: int) -> str:
+        return os.path.abspath(os.path.join(
+            self.checkpoint_dir, f"step-{step}.orbax"))
+
+    def _save_orbax(self, step: int):
+        import jax
+        import orbax.checkpoint as ocp
+
+        net = self.net
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        payload = {"params": net.params, "upd": net.updater_states,
+                   "states": net.states, "rng": np.asarray(net._rng)}
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(self._orbax_path(step), payload, force=True)
+        if jax.process_index() == 0:
+            meta = {"step": step, "iteration": int(net.iteration),
+                    "epoch": int(net.epoch), "format": "orbax"}
+            tmp = os.path.join(self.checkpoint_dir, "latest.json.tmp")
+            with open(tmp, "w") as f:
+                json.dump(meta, f)
+            os.replace(tmp,
+                       os.path.join(self.checkpoint_dir, "latest.json"))
+
+    def _load_orbax(self, meta) -> int:
+        import jax
+        import orbax.checkpoint as ocp
+
+        net = self.net
+        if net.params is None:
+            net.init()
+        with ocp.StandardCheckpointer() as ckptr:
+            data = ckptr.restore(self._orbax_path(meta["step"]))
+        net.params = self._replicated(data["params"])
+        net.updater_states = self._replicated(data["upd"])
+        net.states = self._replicated(data["states"])
+        net._rng = jax.numpy.asarray(np.asarray(data["rng"]))
+        net.iteration = meta["iteration"]
+        net.epoch = meta["epoch"]
+        self._staged = True
+        return meta["step"]
+
     def load_latest_checkpoint(self) -> int:
         """Restore the newest checkpoint if present; returns the step to
         resume FROM (0 if none). All processes load the same file."""
@@ -439,6 +494,8 @@ class TrainingMaster:
             return 0
         with open(latest) as f:
             meta = json.load(f)
+        if meta.get("format") == "orbax":
+            return self._load_orbax(meta)
         step = meta["step"]
         data = np.load(self._ckpt_path(step))
         import jax
@@ -467,7 +524,7 @@ class TrainingMaster:
             return []
         out = []
         for fn in sorted(os.listdir(self.checkpoint_dir)):
-            m = re.match(r"step-(\d+)\.npz$", fn)
+            m = re.match(r"step-(\d+)\.(npz|orbax)$", fn)
             if m:
                 out.append(int(m.group(1)))
-        return out
+        return sorted(out)
