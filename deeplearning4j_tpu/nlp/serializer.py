@@ -1,6 +1,6 @@
 """WordVectorSerializer (parity: models/embeddings/loader/
-WordVectorSerializer.java): Google word2vec-compatible text format +
-a native npz format carrying the full training state."""
+WordVectorSerializer.java): Google word2vec-compatible text AND binary
+formats + a native npz format carrying the full training state."""
 
 from __future__ import annotations
 
@@ -29,6 +29,63 @@ class WordVectorSerializer:
                 f.write(f"{word} {vec}\n")
 
     writeWordVectors = write_word_vectors
+
+    # ---------------- binary (Google word2vec .bin) ----------------
+    @staticmethod
+    def write_word_vectors_binary(model: SequenceVectors, path):
+        """Google word2vec .bin layout (the loadGoogleModel/
+        writeWordVectors binary path): "<vocab> <dim>\n" header, then
+        per word: "<word> " + dim little-endian f32s + "\n"."""
+        opener = gzip.open if str(path).endswith(".gz") else open
+        with opener(path, "wb") as f:
+            V, D = model.syn0.shape
+            f.write(f"{V} {D}\n".encode())
+            for i in range(V):
+                f.write(model.vocab.word_at_index(i).encode("utf-8"))
+                f.write(b" ")
+                f.write(np.asarray(model.syn0[i],
+                                   "<f4").tobytes())
+                f.write(b"\n")
+
+    @staticmethod
+    def read_word_vectors_binary(path) -> SequenceVectors:
+        """Read a Google word2vec .bin (incl. files written by the
+        original C tool: the trailing newline after each vector is
+        optional there, so it is consumed only if present)."""
+        opener = gzip.open if str(path).endswith(".gz") else open
+        with opener(path, "rb") as raw:
+            f = raw if str(path).endswith(".gz") \
+                else io.BufferedReader(raw)
+            header = f.readline().split()
+            V, D = int(header[0]), int(header[1])
+            words, vecs = [], np.empty((V, D), np.float32)
+            for i in range(V):
+                chars = []
+                while True:
+                    c = f.read(1)
+                    if not c or c == b" ":
+                        break
+                    if c == b"\n":       # some writers pad with \n
+                        continue
+                    chars.append(c)
+                words.append(b"".join(chars).decode("utf-8"))
+                vecs[i] = np.frombuffer(f.read(4 * D), "<f4")
+            if len(set(words)) != len(words):
+                raise ValueError(
+                    "duplicate words in binary word-vector file")
+            model = SequenceVectors(layer_size=D)
+            for w in words:
+                model.vocab.add_token(w)
+            model.vocab.finalize_vocab()
+            # preserve file order: map rows by vocab index
+            syn0 = np.empty_like(vecs)
+            for w, v in zip(words, vecs):
+                syn0[model.vocab.index_of(w)] = v
+            model.syn0 = syn0
+            return model
+
+    writeWordVectorsBinary = write_word_vectors_binary
+    readWordVectorsBinary = read_word_vectors_binary
 
     @staticmethod
     def read_word_vectors(path) -> SequenceVectors:

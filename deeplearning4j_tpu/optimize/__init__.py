@@ -3,6 +3,7 @@ from deeplearning4j_tpu.optimize.listeners import (  # noqa: F401
     CollectScoresIterationListener,
     EvaluativeListener,
     InvocationType,
+    ParamAndGradientIterationListener,
     PerformanceListener,
     ScoreIterationListener,
     SleepyTrainingListener,

@@ -1,8 +1,8 @@
 """Training listeners (parity: deeplearning4j-nn optimize/listeners/ —
 ScoreIterationListener, PerformanceListener.java:21-70 samples/batches per
 sec, EvaluativeListener w/ InvocationType, CollectScoresIterationListener,
-TimeIterationListener, SleepyTrainingListener, CheckpointListener role of
-earlystopping savers).
+ParamAndGradientIterationListener, TimeIterationListener,
+SleepyTrainingListener, CheckpointListener role of earlystopping savers).
 
 Contract: `iteration_done(model, iteration)` each step; optional
 `on_epoch_start/on_epoch_end(model)`.
@@ -137,6 +137,94 @@ class CollectScoresIterationListener:
             f.write(f"iteration{delimiter}score\n")
             for it, s in self.scores:
                 f.write(f"{it}{delimiter}{s}\n")
+
+
+class ParamAndGradientIterationListener:
+    """Tab-separated per-iteration parameter/update statistics written
+    to a file or the log (ref: ParamAndGradientIterationListener.java
+    :30-102 — printMean/printMinMax/printMeanAbsValue knobs). The
+    update statistics come from parameter deltas between calls (the
+    reference reads Model.gradient(); here the compiled step has no
+    exposed gradient, and delta = applied update)."""
+
+    def __init__(self, iterations: int = 1, print_mean: bool = True,
+                 print_min_max: bool = True,
+                 print_mean_abs_value: bool = True,
+                 output_file: Optional[str] = None, delimiter: str = "\t",
+                 log=None):
+        self.n = max(1, iterations)
+        self.print_mean = print_mean
+        self.print_min_max = print_min_max
+        self.print_mean_abs = print_mean_abs_value
+        self.path = output_file
+        self.delim = delimiter
+        self.log = log or (lambda msg: logger.info(msg))
+        self._prev = None
+        self._wrote_header = False
+
+    def _stats(self, arr):
+        import numpy as np
+
+        out = []
+        if self.print_mean:
+            out.append(f"{float(np.mean(arr)):.6g}")
+        if self.print_min_max:
+            out.append(f"{float(np.min(arr)):.6g}")
+            out.append(f"{float(np.max(arr)):.6g}")
+        if self.print_mean_abs:
+            out.append(f"{float(np.mean(np.abs(arr))):.6g}")
+        return out
+
+    def _emit(self, line: str):
+        if self.path:
+            # first emit truncates: a rerun must not append a second
+            # header after a previous run's rows
+            mode = "a" if self._wrote_header else "w"
+            with open(self.path, mode) as f:
+                f.write(line + "\n")
+        else:
+            self.log(line)
+
+    def _n_stat_cols(self):
+        return (int(self.print_mean) + 2 * int(self.print_min_max)
+                + int(self.print_mean_abs))
+
+    def iteration_done(self, model, iteration: int):
+        import jax
+        import numpy as np
+
+        prints = iteration % self.n == 0
+        next_prints = (iteration + 1) % self.n == 0
+        if not (prints or next_prints):
+            # neither this row nor the next one needs these params:
+            # skip the device->host transfer entirely
+            self._prev = None
+            return
+        flat = np.concatenate(
+            [np.asarray(a).ravel()
+             for a in jax.tree_util.tree_leaves(model.params)])
+        if prints:
+            if not self._wrote_header:
+                cols = ["iteration", "score"]
+                names = []
+                if self.print_mean:
+                    names.append("mean")
+                if self.print_min_max:
+                    names += ["min", "max"]
+                if self.print_mean_abs:
+                    names.append("meanAbs")
+                for group in ("param", "update"):
+                    cols += [f"{group}_{n}" for n in names]
+                self._emit(self.delim.join(cols))
+                self._wrote_header = True
+            vals = [str(iteration), f"{model.score():.6g}"]
+            vals += self._stats(flat)
+            if self._prev is not None:
+                vals += self._stats(flat - self._prev)
+            else:
+                vals += ["-"] * self._n_stat_cols()
+            self._emit(self.delim.join(vals))
+        self._prev = flat if next_prints else None
 
 
 class TimeIterationListener:

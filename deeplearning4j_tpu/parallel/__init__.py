@@ -30,3 +30,7 @@ from deeplearning4j_tpu.parallel.dcn_model import (  # noqa: F401
     crossover_report,
     sweep as dcn_sweep,
 )
+from deeplearning4j_tpu.parallel.repartition import (  # noqa: F401
+    BalancedPartitioner,
+    HashingBalancedPartitioner,
+)

@@ -294,3 +294,22 @@ def test_word2vec_dense_lazy_tables_and_serialization(tmp_path):
     loaded = WordVectorSerializer.read_word_vectors(p)
     np.testing.assert_allclose(loaded.get_word_vector("cat"),
                                w2v.get_word_vector("cat"), atol=1e-5)
+
+
+def test_word2vec_binary_serialization_round_trip(tmp_path):
+    """Google word2vec .bin format round trip (the loadGoogleModel
+    binary path of WordVectorSerializer.java)."""
+    sents, _, _ = _corpus(n=40)
+    w2v = (Word2Vec.Builder().layer_size(12).epochs(1).seed(2)
+           .iterate(CollectionSentenceIterator(sents)).build())
+    w2v.fit()
+    p = tmp_path / "vecs.bin"
+    WordVectorSerializer.write_word_vectors_binary(w2v, p)
+    loaded = WordVectorSerializer.read_word_vectors_binary(p)
+    assert loaded.vocab.num_words() == w2v.vocab.num_words()
+    np.testing.assert_allclose(loaded.get_word_vector("cat"),
+                               w2v.get_word_vector("cat"), atol=1e-6)
+    # words survive byte-exact incl. order-independent lookup
+    for w in ("cat", "dog", "cpu"):
+        np.testing.assert_allclose(loaded.get_word_vector(w),
+                                   w2v.get_word_vector(w), atol=1e-6)

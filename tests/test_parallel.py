@@ -546,3 +546,33 @@ def test_dcn_crossover_model():
     ex = [s["exchange_ms"] for s in slow]
     assert ex == sorted(ex)
     assert allreduce_ms(params, 1, DcnLink()) == 0.0
+
+
+def test_balanced_partitioner():
+    """BalancedPartitioner.java:23-35 semantics: remainder spread over
+    the first partitions, contiguous bounds."""
+    from deeplearning4j_tpu.parallel import BalancedPartitioner
+
+    p = BalancedPartitioner(n_partitions=3, n_elements=10)
+    assert p.sizes == [4, 3, 3]
+    assert [p.partition_of(i) for i in range(10)] == \
+        [0, 0, 0, 0, 1, 1, 1, 2, 2, 2]
+    assert p.bounds(0) == (0, 4) and p.bounds(2) == (7, 10)
+    with pytest.raises(IndexError):
+        p.partition_of(10)
+
+
+def test_hashing_balanced_partitioner_balances_classes():
+    """Per-class round-robin keeps every partition ~class-balanced
+    (HashingBalancedPartitioner role)."""
+    from deeplearning4j_tpu.parallel import HashingBalancedPartitioner
+
+    hp = HashingBalancedPartitioner(n_partitions=4)
+    keys = ["a"] * 40 + ["b"] * 40
+    parts = hp.assign(keys)
+    for cls, lo in (("a", 0), ("b", 40)):
+        per = np.bincount(parts[lo:lo + 40], minlength=4)
+        assert per.min() == per.max() == 10, (cls, per)
+    # determinism: a fresh instance assigns identically
+    hp2 = HashingBalancedPartitioner(n_partitions=4)
+    np.testing.assert_array_equal(hp2.assign(keys), parts)

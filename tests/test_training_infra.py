@@ -184,3 +184,30 @@ def test_checkpoint_listener(rng, tmp_path):
     net.fit(ListDataSetIterator(ds, 30), epochs=3)
     zips = list(tmp_path.glob("checkpoint_*.zip"))
     assert len(zips) == 2  # keep_last pruned
+
+
+def test_param_and_gradient_iteration_listener(tmp_path, rng):
+    """ParamAndGradientIterationListener.java role: per-iteration
+    param/update stats with the reference's column knobs, written to a
+    delimited file."""
+    from deeplearning4j_tpu.optimize import (
+        ParamAndGradientIterationListener,
+    )
+
+    out = tmp_path / "pg.tsv"
+    net = _net(n_in=4)
+    net.listeners.append(ParamAndGradientIterationListener(
+        iterations=1, output_file=str(out)))
+    x = rng.normal(size=(16, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    net.fit([(x, y)] * 3)
+    lines = out.read_text().strip().splitlines()
+    header = lines[0].split("\t")
+    assert header[:2] == ["iteration", "score"]
+    assert "param_mean" in header and "update_meanAbs" in header
+    assert len(lines) == 4          # header + 3 iterations
+    # first row has no previous params -> update stats are placeholders
+    assert "-" in lines[1].split("\t")
+    # later rows carry real update magnitudes
+    last = dict(zip(header, lines[-1].split("\t")))
+    assert float(last["update_meanAbs"]) > 0
