@@ -219,6 +219,60 @@ def bench_word2vec(vocab=5000, n_words=2_000_000, dim=128, window=5,
     return n_words * epochs / dt, dt
 
 
+def bench_vgg16(batch=32, hw=224, iters=12):
+    """BASELINE config #4 at full fidelity: canonical Keras VGG16
+    (138.4M params) imported from HDF5, frozen-base vs full fine-tune
+    step times at 224x224 with TrainedModels.VGG16 preprocessing.
+    Run with `python bench.py vgg16`. Generates a random-weight VGG16
+    .h5 via tf.keras on first use (cached in /tmp)."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.datasets.normalizers import (
+        VGG16ImagePreProcessor,
+    )
+    from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+    from deeplearning4j_tpu.nn.transferlearning import TransferLearning
+
+    h5 = "/tmp/vgg16_224_bench.h5"
+    if not os.path.exists(h5):
+        import tensorflow as tf
+
+        tf.keras.applications.VGG16(weights=None, classes=1000).save(h5)
+    rng = np.random.default_rng(0)
+    mean = np.asarray(VGG16ImagePreProcessor.MEAN_RGB, np.float32)
+    x = jax.device_put(jnp.asarray(
+        rng.uniform(0, 255, (batch, hw, hw, 3)).astype(np.float32)
+        - mean))
+    y = jax.device_put(jnp.asarray(
+        np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)]))
+    _ = float(jnp.sum(x[0, 0, 0]))
+
+    def run(net):
+        name = net.conf.network_inputs[0]
+        net._train_step({name: x}, [y])
+        _ = float(net.score())
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            net._train_step({name: x}, [y])
+        _ = float(net.score())
+        dt = (time.perf_counter() - t0) / iters
+        assert np.isfinite(float(net.score()))
+        return dt
+
+    frozen = (TransferLearning.GraphBuilder(
+        KerasModelImport.import_keras_model_and_weights(h5))
+        .set_feature_extractor("block5_pool").build())
+    frozen.compute_dtype = jnp.bfloat16
+    dt_frozen = run(frozen)
+    full = KerasModelImport.import_keras_model_and_weights(h5)
+    full.compute_dtype = jnp.bfloat16
+    dt_full = run(full)
+    return dt_frozen, dt_full, batch
+
+
 def main():
     import sys
 
@@ -235,6 +289,22 @@ def main():
             "total_s": round(dt, 1),
             "config": "vocab=5k zipf dim=128 window=5 K=5 "
                       "5 epochs x 2M words, dense tier",
+            "device": str(dev.device_kind),
+            "platform": str(dev.platform),
+            "jax": jax.__version__,
+        }))
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "vgg16":
+        dt_frozen, dt_full, b = bench_vgg16()
+        print(json.dumps({
+            "metric": "vgg16_finetune_224_images_per_sec_per_chip",
+            "value": round(b / dt_full, 1),
+            "unit": "images/sec/chip",
+            "vs_baseline": 1.0,
+            "full_step_ms": round(dt_full * 1e3, 1),
+            "frozen_step_ms": round(dt_frozen * 1e3, 1),
+            "frozen_images_per_sec": round(b / dt_frozen, 1),
+            "config": f"batch={b} bf16 224x224 canonical keras VGG16",
             "device": str(dev.device_kind),
             "platform": str(dev.platform),
             "jax": jax.__version__,
