@@ -6,7 +6,10 @@ CUDA backend is present, falling back to the built-in path otherwise
 (CudnnConvolutionHelper.java:54,120). Here the built-in path is XLA
 (`lax.conv_general_dilated` — already MXU-tiled), and the helper tier is
 a graph-level fusion pass (fused_graph.py, built on the custom-VJP
-pipeline op in fused_ops.py) that cuts HBM pass count by fusing BN
+pipeline op in fused_ops.py; ComputationGraph nets only — the conv
+architectures that profit all live in the graph container, and PERF.md
+measured the tier at parity with XLA's own fusion, so the MLN chain
+keeps the default path) that cuts HBM pass count by fusing BN
 statistics, BN application, activation, and residual adds into the
 convolutions' prologues/epilogues, plus hand-written Pallas kernels for
 the shapes where manual tiling wins (pallas_conv.py). Selection mirrors
