@@ -94,6 +94,7 @@ class AsyncDataSetIterator(DataSetIterator):
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         self._gen = 0  # restart generation: stale producers self-terminate
+        self._exhausted = False
 
     def _start(self):
         self._gen += 1
@@ -130,20 +131,29 @@ class AsyncDataSetIterator(DataSetIterator):
     def __iter__(self):
         if hasattr(self.base, "reset"):
             self.base.reset()
+        self._exhausted = False
         self._start()
         return self
 
     def reset(self):
         if hasattr(self.base, "reset"):
             self.base.reset()
+        self._exhausted = False
         self._start()
 
     def __next__(self):
+        if self._exhausted:
+            # iterator protocol: an exhausted iterator keeps raising
+            # StopIteration until __iter__/reset explicitly starts a
+            # new pass (restarting here silently fed wrapping
+            # pipelines a second epoch)
+            raise StopIteration
         if self._q is None:
             self._start()
         item = self._q.get()
         if item is self._SENTINEL:
             self._q = None
+            self._exhausted = True
             if self._error is not None:
                 raise self._error
             raise StopIteration
@@ -259,6 +269,7 @@ class DevicePrefetchIterator(DataSetIterator):
         self.sharding = sharding
         self._src = None
         self._staged = None
+        self._src_done = False
 
     def _put(self, item):
         import jax
@@ -279,6 +290,7 @@ class DevicePrefetchIterator(DataSetIterator):
             self.base.reset()
         self._src = None
         self._staged = None
+        self._src_done = False
 
     def __iter__(self):
         if self._staged is not None:
@@ -288,11 +300,13 @@ class DevicePrefetchIterator(DataSetIterator):
             # a genuinely fresh pass.
             return self
         self._src = iter(self.base)
+        self._src_done = False
         self._staged = []
         for _ in range(self.buffer_size):
             try:
                 self._staged.append(self._put(next(self._src)))
             except StopIteration:
+                self._src_done = True
                 break
         return self
 
@@ -310,8 +324,11 @@ class DevicePrefetchIterator(DataSetIterator):
             self._staged = None
             raise StopIteration
         out = self._staged.pop(0)
-        try:
-            self._staged.append(self._put(next(self._src)))
-        except StopIteration:
-            pass
+        if not self._src_done:
+            # never call next() again after exhaustion: a multi-epoch
+            # base would hand us its following epoch
+            try:
+                self._staged.append(self._put(next(self._src)))
+            except StopIteration:
+                self._src_done = True
         return out

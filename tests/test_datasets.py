@@ -168,3 +168,34 @@ def test_lfw_and_curves_iterators():
     b = next(iter(cur))
     assert b.features.shape == (20, 784)
     np.testing.assert_array_equal(b.features, b.labels)  # autoencoder
+
+
+def test_exhausted_iterators_keep_raising_stop_iteration(rng):
+    """Iterator-protocol regression (found by an on-chip pipeline
+    drive): AsyncDataSetIterator restarted a fresh epoch when next()
+    was called after exhaustion, so DevicePrefetchIterator silently
+    delivered DOUBLE epochs. Exhausted iterators must keep raising
+    StopIteration until __iter__/reset."""
+    from deeplearning4j_tpu.datasets.iterators import (
+        AsyncDataSetIterator,
+        DevicePrefetchIterator,
+        ListDataSetIterator,
+    )
+
+    ds = DataSet(rng.normal(size=(128, 4)).astype(np.float32),
+                 np.eye(2, dtype=np.float32)[rng.integers(0, 2, 128)])
+    a = AsyncDataSetIterator(ListDataSetIterator(ds, batch_size=16),
+                             queue_size=3)
+    assert sum(1 for _ in a) == 8
+    with pytest.raises(StopIteration):
+        next(a)                      # stays exhausted
+    with pytest.raises(StopIteration):
+        next(a)
+    assert sum(1 for _ in a) == 8    # explicit __iter__ = fresh pass
+
+    pf = DevicePrefetchIterator(
+        AsyncDataSetIterator(ListDataSetIterator(ds, batch_size=16),
+                             queue_size=3), buffer_size=3)
+    assert sum(1 for _ in pf) == 8   # was 16 before the fix
+    pf.reset()
+    assert sum(1 for _ in pf) == 8
