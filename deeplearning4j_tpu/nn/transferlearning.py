@@ -246,20 +246,21 @@ class TransferLearning:
 
 class TransferLearningHelper:
     """Featurize-once helper (ref: TransferLearningHelper.java): run the
-    frozen prefix once per dataset, then train only the unfrozen tail on
-    the cached features."""
+    frozen prefix once per dataset (`featurize`), train only the
+    unfrozen tail on the cached features (`fitFeaturized`), and write
+    the trained tail back into the original network — the frozen
+    forward pass is paid once per dataset instead of once per epoch."""
 
     def __init__(self, net, frozen_up_to: int):
         self.net = net
         self.frozen_up_to = frozen_up_to
+        self._tail = None
 
     def featurize(self, x):
         import jax.numpy as jnp
 
-        acts = x
         net = self.net
-        acts = jnp.asarray(acts, net.dtype)
-        cur = acts
+        cur = jnp.asarray(x, net.dtype)
         for i in range(self.frozen_up_to + 1):
             if i in net.conf.preprocessors:
                 cur = net.conf.preprocessors[i].preprocess(cur)
@@ -267,3 +268,66 @@ class TransferLearningHelper:
                 net.params[i], cur, train=False,
                 state=net.states[i] if net.states[i] else None)
         return np.asarray(cur)
+
+    @staticmethod
+    def _input_type_of(feat: np.ndarray):
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+        if feat.ndim == 4:
+            return InputType.convolutional(*feat.shape[1:])
+        if feat.ndim == 3:
+            return InputType.recurrent(feat.shape[-1])
+        return InputType.feed_forward(feat.shape[-1])
+
+    def unfrozen_mln(self, example_features: np.ndarray):
+        """The tail-only network trained by fit_featurized (built
+        lazily from a featurized batch's shape — ref
+        TransferLearningHelper.unfrozenMLN)."""
+        if self._tail is None:
+            from deeplearning4j_tpu.nn.multilayer import (
+                MultiLayerNetwork,
+            )
+
+            k = self.frozen_up_to
+            conf = self.net.conf
+            tail_conf = copy.deepcopy(conf)
+            tail_conf.layers = [copy.deepcopy(l)
+                                for l in conf.layers[k + 1:]]
+            tail_conf.preprocessors = {
+                i - (k + 1): p for i, p in conf.preprocessors.items()
+                if i > k}
+            tail_conf.input_type = self._input_type_of(
+                np.asarray(example_features))
+            tail_conf.resolve_shapes()
+            tail = MultiLayerNetwork(tail_conf,
+                                     dtype=self.net.dtype).init()
+            tail.compute_dtype = self.net.compute_dtype
+            # adopt the original unfrozen params/states so fitting
+            # CONTINUES from the current model
+            tail.params = [self.net.params[i]
+                           for i in range(k + 1, len(conf.layers))]
+            tail.states = [self.net.states[i]
+                           for i in range(k + 1, len(conf.layers))]
+            self._tail = tail
+        return self._tail
+
+    def fit_featurized(self, data, epochs: int = 1):
+        """Train the tail on (featurized_x, y) batches (a tuple, a
+        DataSet, or an iterable of either), then write the trained
+        params/states back into the wrapped network."""
+        batches = data if isinstance(data, (list, tuple))             and not (len(data) in (2, 4)
+                     and hasattr(data[0], "shape")) else [data]
+        first = batches[0]
+        fx = first.features if hasattr(first, "features") else first[0]
+        tail = self.unfrozen_mln(fx)
+        for _ in range(epochs):
+            tail.fit(batches)
+        k = self.frozen_up_to
+        for j, i in enumerate(range(k + 1, len(self.net.conf.layers))):
+            self.net.params[i] = tail.params[j]
+            self.net.states[i] = tail.states[j]
+        return self
+
+    # camelCase parity
+    fitFeaturized = fit_featurized
+    unfrozenMLN = unfrozen_mln

@@ -211,3 +211,49 @@ def test_param_and_gradient_iteration_listener(tmp_path, rng):
     # later rows carry real update magnitudes
     last = dict(zip(header, lines[-1].split("\t")))
     assert float(last["update_meanAbs"]) > 0
+
+
+def test_transfer_learning_helper_featurized_workflow(rng):
+    """TransferLearningHelper.fitFeaturized (ref
+    TransferLearningHelper.java): cache the frozen prefix's features
+    once, train only the tail on them, trained tail lands back in the
+    original net and the frozen prefix is untouched."""
+    from deeplearning4j_tpu.nn.conf import InputType
+    from deeplearning4j_tpu.nn.layers import (
+        ConvolutionLayer,
+        DenseLayer,
+        OutputLayer,
+    )
+    from deeplearning4j_tpu.nn.transferlearning import (
+        TransferLearningHelper,
+    )
+
+    x = rng.normal(size=(128, 8, 8, 1)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[
+        (x.sum((1, 2, 3)) > 0).astype(int)]
+    conf = (NeuralNetConfiguration.Builder().seed(1).updater("adam")
+            .learning_rate(5e-3).activation("relu")
+            .weight_init("xavier").list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3)))
+            .layer(DenseLayer(n_out=16))
+            .layer(OutputLayer(n_out=2, loss="mcxent"))
+            .set_input_type(InputType.convolutional(8, 8, 1)).build())
+    net = MultiLayerNetwork(conf).init()
+    helper = TransferLearningHelper(net, frozen_up_to=0)
+    feats = helper.featurize(x)
+    assert feats.shape == (128, 6, 6, 4)
+    frozen_before = np.asarray(net.params[0]["W"]).copy()
+    head_before = np.asarray(net.params[2]["W"]).copy()
+    before = float(net.score((x, y)))
+    for _ in range(15):
+        helper.fit_featurized((feats, y))
+    after = float(net.score((x, y)))
+    assert after < before, (before, after)
+    np.testing.assert_array_equal(
+        np.asarray(net.params[0]["W"]), frozen_before)   # frozen fixed
+    assert np.abs(np.asarray(net.params[2]["W"])
+                  - head_before).max() > 1e-5            # head trained
+    # predictions through the FULL net equal tail-on-features
+    full = np.asarray(net.output(x))
+    tail = np.asarray(helper.unfrozen_mln(feats).output(feats))
+    np.testing.assert_allclose(full, tail, rtol=1e-5, atol=1e-6)
