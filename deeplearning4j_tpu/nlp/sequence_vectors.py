@@ -295,9 +295,11 @@ class _DenseSteps:
     Differences from the scan tier above, chosen for throughput:
 
     - One batched update per batch of B pairs; in-batch duplicate rows
-      contribute the MEAN of their gradients (see _dedup_scatter_add —
-      a summed scatter multiplies the head words' effective lr by
-      their in-batch count and NaNs the table on zipf vocabularies).
+      apply a CAPPED SUM of their gradients: full summed gradient up
+      to _DUP_CAP occurrences, rescaled to the cap beyond (see
+      _dedup_scatter_add — an uncapped summed scatter multiplies the
+      head words' effective lr by their in-batch count and NaNs the
+      table on zipf vocabularies, while a plain mean starves them).
       At small vocab the chunk-sequential scan tier remains the
       default (see SequenceVectors._ensure_steps).
     - The device step is pure gather -> VPU elementwise -> scatter-add:

@@ -111,10 +111,26 @@ class RBM(BaseLayer):
 
     # ------------------------------------------------------ free energy
     def free_energy(self, params, v):
-        """F(v) = -v.vb [+ ||v-vb||^2/2 gaussian] - sum softplus(vW+b).
-        Mean over the batch."""
+        """F(v) = vis_term - hidden_term, mean over the batch.
+
+        The hidden term comes from integrating the hidden units out of
+        the joint energy, so it is UNIT-SPECIFIC: sum softplus(vW+b)
+        for BINARY hidden units, sum (vW+b)^2/2 for unit-variance
+        GAUSSIAN hidden units. RECTIFIED/IDENTITY hidden units have no
+        closed-form free energy — pretrain_loss rejects them so the
+        CD-k-as-free-energy-gradient identity is never silently wrong
+        (the reference instead builds unit-specific CD matrices,
+        RBM.java contrastiveDivergence :102)."""
         z = v @ params["W"] + params["b"]
-        hidden_term = jnp.sum(jax.nn.softplus(z), axis=-1)
+        if self.hidden_unit == "BINARY":
+            hidden_term = jnp.sum(jax.nn.softplus(z), axis=-1)
+        elif self.hidden_unit == "GAUSSIAN":
+            hidden_term = 0.5 * jnp.sum(z * z, axis=-1)
+        else:
+            raise NotImplementedError(
+                f"free_energy has no closed form for "
+                f"{self.hidden_unit} hidden units; CD pretraining "
+                "supports BINARY/GAUSSIAN hidden units only")
         if self.visible_unit == "GAUSSIAN":
             vis_term = 0.5 * jnp.sum((v - params["vb"]) ** 2, axis=-1)
         else:

@@ -315,8 +315,13 @@ class TransferLearningHelper:
         """Train the tail on (featurized_x, y) batches (a tuple, a
         DataSet, or an iterable of either), then write the trained
         params/states back into the wrapped network."""
-        batches = data if isinstance(data, (list, tuple))             and not (len(data) in (2, 4)
-                     and hasattr(data[0], "shape")) else [data]
+        if not isinstance(data, (list, tuple)) and not hasattr(
+                data, "features") and hasattr(data, "__iter__"):
+            data = list(data)   # materialize one-shot iterators
+        is_single_batch = (not isinstance(data, (list, tuple))
+                           or (len(data) in (2, 4)
+                               and hasattr(data[0], "shape")))
+        batches = [data] if is_single_batch else list(data)
         first = batches[0]
         fx = first.features if hasattr(first, "features") else first[0]
         tail = self.unfrozen_mln(fx)

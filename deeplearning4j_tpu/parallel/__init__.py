@@ -24,6 +24,10 @@ from deeplearning4j_tpu.parallel.wrapper import (  # noqa: F401
     StaleGradientTrainer,
 )
 from deeplearning4j_tpu.parallel.inference import ParallelInference  # noqa: F401
+from deeplearning4j_tpu.parallel.serving import (  # noqa: F401
+    ModelClient,
+    ModelServer,
+)
 from deeplearning4j_tpu.parallel.dcn_model import (  # noqa: F401
     DcnLink,
     allreduce_ms,

@@ -36,7 +36,7 @@ class DcnLink:
     latency. (Field is GB/s, not Gbps — divide a NIC's line rate in
     gigabits by 8.)"""
 
-    bandwidth_gbps: float = 25.0    # gigabytes per second
+    bandwidth_GBps: float = 25.0    # gigaBYTES per second
     latency_ms: float = 0.1
 
 
@@ -45,7 +45,7 @@ def allreduce_ms(nbytes: float, n_slices: int, link: DcnLink) -> float:
     + 2(n-1) * alpha."""
     if n_slices <= 1:
         return 0.0
-    bw = link.bandwidth_gbps * 1e9 / 1e3          # bytes per ms
+    bw = link.bandwidth_GBps * 1e9 / 1e3          # bytes per ms
     return (2.0 * (n_slices - 1) / n_slices * nbytes / bw
             + 2.0 * (n_slices - 1) * link.latency_ms)
 

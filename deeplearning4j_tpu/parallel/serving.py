@@ -1,0 +1,163 @@
+"""Network-facing model serving over ParallelInference.
+
+Parity: dl4j-streaming's Camel serve route
+(streaming/routes/DL4jServeRouteBuilder.java — accept a record over
+the wire, run `model.output`, hand the result to a post-processor) and
+the ModelServer role around ParallelInference. Kafka/Camel transports
+stay out of scope (VERDICT r4); the serving surface itself is plain
+HTTP+JSON like the nearest-neighbor microservice
+(clustering/server.py), so the round-trip is testable anywhere.
+
+Routes:
+  POST /predict  {"inputs": [[...], ...]}          -> {"outputs": [...]}
+  POST /predict  {"inputs": ..., "decode_top": 5}  -> adds "decoded"
+                 (requires an ImageNetLabels source; zoo/util/imagenet)
+  GET  /status   -> model + queue facts
+
+Requests are funneled through ParallelInference in BATCHED mode, so
+concurrent small clients coalesce into full MXU tiles (the reference's
+BatchedInferenceObservable role).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.parallel.inference import (
+    InferenceMode,
+    ParallelInference,
+)
+
+
+class ModelServer:
+    """Serve a trained MultiLayerNetwork/ComputationGraph over HTTP.
+
+    `labels` (optional ImageNetLabels) enables decoded top-k responses
+    — the user-facing half of the zoo (`decode_predictions`)."""
+
+    def __init__(self, net, port: int = 0, host: str = "127.0.0.1",
+                 inference_mode: str = InferenceMode.BATCHED,
+                 batch_limit: int = 32, labels=None,
+                 output_activation: bool = True):
+        self._owns_pi = not isinstance(net, ParallelInference)
+        self.pi = (net if not self._owns_pi
+                   else ParallelInference(net, inference_mode,
+                                          batch_limit=batch_limit))
+        self.labels = labels
+        self.host = host
+        self.port = port
+        self._httpd = None
+        self._thread: Optional[threading.Thread] = None
+        self._served = 0
+        self._served_lock = threading.Lock()
+
+    def start(self) -> "ModelServer":
+        import http.server
+        import socketserver
+
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def _send(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path.rstrip("/") == "/status":
+                    self._send(200, {
+                        "model": type(server.pi.net).__name__,
+                        "inference_mode": server.pi.mode,
+                        "batch_limit": server.pi.batch_limit,
+                        "served": server._served,
+                        "has_labels": server.labels is not None})
+                else:
+                    self._send(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                try:
+                    if self.path.rstrip("/") != "/predict":
+                        raise ValueError(f"no route {self.path}")
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n).decode())
+                    x = np.asarray(req["inputs"], np.float32)
+                    if req.get("single", False):
+                        x = x[None, ...]   # one unbatched example
+                    out = np.asarray(server.pi.output(x))
+                    with server._served_lock:
+                        server._served += x.shape[0]
+                    resp = {"outputs": out.tolist()}
+                    top = int(req.get("decode_top", 0))
+                    if top > 0:
+                        if server.labels is None:
+                            raise ValueError(
+                                "server started without labels; "
+                                "decode_top unavailable")
+                        resp["decoded"] = [
+                            [{"class": c, "wnid": w, "label": l,
+                              "probability": p}
+                             for (c, w, l, p) in row]
+                            for row in server.labels.decode_predictions(
+                                out, top=top)]
+                    self._send(200, resp)
+                except Exception as e:   # noqa: BLE001 - HTTP boundary
+                    self._send(400, {"error": str(e)})
+
+            def log_message(self, *a):
+                pass
+
+        class _Server(socketserver.ThreadingMixIn, http.server.HTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._httpd = _Server((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._owns_pi:   # never kill a caller-supplied front-end
+            self.pi.shutdown()
+
+
+class ModelClient:
+    """Minimal client for ModelServer (the serve-route consumer)."""
+
+    def __init__(self, url: str, timeout: float = 30.0):
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+
+    def _post(self, route: str, payload: dict) -> dict:
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.url + route, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return json.loads(r.read().decode())
+
+    def predict(self, inputs, decode_top: int = 0) -> dict:
+        payload = {"inputs": np.asarray(inputs).tolist()}
+        if decode_top:
+            payload["decode_top"] = decode_top
+        return self._post("/predict", payload)
+
+    def status(self) -> dict:
+        import urllib.request
+
+        with urllib.request.urlopen(self.url + "/status",
+                                    timeout=self.timeout) as r:
+            return json.loads(r.read().decode())

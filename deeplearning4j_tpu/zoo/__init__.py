@@ -19,3 +19,7 @@ from deeplearning4j_tpu.zoo.models import (  # noqa: F401
     VGG16,
     VGG19,
 )
+from deeplearning4j_tpu.zoo.util.imagenet import (  # noqa: F401
+    ImageNetLabels,
+    decode_predictions,
+)

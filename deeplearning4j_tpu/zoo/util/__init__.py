@@ -1,0 +1,4 @@
+from deeplearning4j_tpu.zoo.util.imagenet import (  # noqa: F401
+    ImageNetLabels,
+    decode_predictions,
+)

@@ -1,0 +1,124 @@
+"""ImageNet class labels + top-k prediction decoding.
+
+Parity: zoo/util/imagenet/ImageNetLabels.java (labels fetched from the
+canonical class-index JSON at runtime, getLabel :47, decodePredictions
+:57) and the TrainedModels decode-predictions role
+(modelimport/keras/trainedmodels/TrainedModels.java:155
+decodePredictions / getPredictions).
+
+The reference does NOT vendor the 1000 labels — it downloads
+`imagenet_class_index.json` ({"0": ["n01440764", "tench"], ...}) on
+first use. This loader does the same, with an explicit local-path
+override for air-gapped hosts, and caches the parsed list per path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# The class-index map published with keras-applications; the same
+# content the reference fetches from blob.deeplearning4j.org
+# (ImageNetLabels.java:19).
+DEFAULT_URL = ("https://storage.googleapis.com/download.tensorflow.org/"
+               "data/imagenet_class_index.json")
+DEFAULT_CACHE = os.path.expanduser(
+    "~/.dl4j_tpu/imagenet_class_index.json")
+
+_CACHE: dict = {}
+
+
+def _load_class_index(source: Optional[str]) -> List[Tuple[str, str]]:
+    """-> [(wnid, label)] ordered by class index 0..N-1."""
+    source = source or (DEFAULT_CACHE if os.path.exists(DEFAULT_CACHE)
+                        else DEFAULT_URL)
+    if source in _CACHE:
+        return _CACHE[source]
+    if source.startswith(("http://", "https://")):
+        import urllib.request
+
+        with urllib.request.urlopen(source, timeout=30) as r:
+            raw = json.loads(r.read().decode())
+        os.makedirs(os.path.dirname(DEFAULT_CACHE), exist_ok=True)
+        with open(DEFAULT_CACHE, "w") as f:
+            json.dump(raw, f)
+    else:
+        with open(source) as f:
+            raw = json.load(f)
+    labels = [(raw[str(i)][0], raw[str(i)][1]) for i in range(len(raw))]
+    _CACHE[source] = labels
+    return labels
+
+
+class ImageNetLabels:
+    """ref ImageNetLabels.java. `source` may be a local JSON path (the
+    air-gapped/test path) or an http(s) URL; default tries the local
+    cache then the canonical URL."""
+
+    def __init__(self, source: Optional[str] = None):
+        self._labels = _load_class_index(source)
+
+    def __len__(self):
+        return len(self._labels)
+
+    def get_label(self, n: int) -> str:
+        """Description of the nth class (ImageNetLabels.java:47)."""
+        return self._labels[n][1]
+
+    def get_wnid(self, n: int) -> str:
+        return self._labels[n][0]
+
+    def decode_predictions(self, predictions, top: int = 5):
+        """[(class_idx, wnid, label, prob)] per batch row — the
+        structured form of ImageNetLabels.java:57."""
+        return decode_predictions(predictions, top=top, labels=self)
+
+    def decode_predictions_str(self, predictions, top: int = 5) -> str:
+        """The reference's human-readable report format
+        (ImageNetLabels.java decodePredictions :57)."""
+        preds = np.asarray(predictions)
+        if preds.ndim == 1:
+            preds = preds[None, :]
+        out = []
+        for b, rows in enumerate(self.decode_predictions(preds, top)):
+            head = "Predictions for batch "
+            if preds.shape[0] > 1:
+                head += str(b)
+            head += " :"
+            out.append(head + "".join(
+                f"\n\t{100.0 * p:3f}%, {label}"
+                for (_, _, label, p) in rows))
+        return "\n".join(out)
+
+    # camelCase parity
+    getLabel = get_label
+    decodePredictions = decode_predictions_str
+
+
+def decode_predictions(predictions, top: int = 5,
+                       labels: Optional[ImageNetLabels] = None,
+                       source: Optional[str] = None
+                       ) -> List[List[Tuple[int, str, str, float]]]:
+    """Top-`top` (class_idx, wnid, label, probability) per row, sorted
+    descending — the keras-style decode over a [B, C] probability
+    array (TrainedModels.java decodePredictions role)."""
+    labels = labels or ImageNetLabels(source)
+    preds = np.asarray(predictions, np.float32)
+    if preds.ndim == 1:
+        preds = preds[None, :]
+    if preds.shape[-1] != len(labels):
+        raise ValueError(
+            f"predictions have {preds.shape[-1]} classes, label table "
+            f"has {len(labels)}")
+    k = min(top, preds.shape[-1])
+    top_idx = np.argpartition(-preds, k - 1, axis=-1)[:, :k]
+    out = []
+    for row, idx in zip(preds, top_idx):
+        idx = idx[np.argsort(-row[idx])]
+        out.append([(int(i), labels.get_wnid(int(i)),
+                     labels.get_label(int(i)), float(row[i]))
+                    for i in idx])
+    return out

@@ -540,7 +540,7 @@ def test_dcn_crossover_model():
 
     # a slow link (1 GB/s) pushes sync below target quickly
     slow = dcn_sweep(params, step, [2, 4, 8, 16],
-                     link=DcnLink(bandwidth_gbps=1.0))
+                     link=DcnLink(bandwidth_GBps=1.0))
     assert not slow[-1]["sync_scales"]
     # exchange cost is monotone in slice count
     ex = [s["exchange_ms"] for s in slow]

@@ -132,3 +132,40 @@ def test_rbm_gaussian_visible_pretrain(rng):
     after = float(layer.reconstruction_error(
         net.params[0], jnp.asarray(x)))
     assert after < before, (before, after)
+
+
+def test_rbm_hidden_unit_free_energy_dispatch(rng):
+    """free_energy's hidden term is unit-specific (ADVICE r4): softplus
+    for BINARY, quadratic for GAUSSIAN, loud failure otherwise."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+    v = jnp.asarray(rng.normal(size=(4, 5)).astype(np.float32))
+
+    def make(hidden):
+        layer = RBM(n_out=3, hidden_unit=hidden, weight_init="xavier")
+        layer.set_n_in(InputType.feed_forward(5))
+        params = layer.init_params(jax.random.PRNGKey(0),
+                                   InputType.feed_forward(5))
+        return layer, params
+
+    layer_b, params = make("BINARY")
+    layer_g, _ = make("GAUSSIAN")
+    z = v @ params["W"] + params["b"]
+    fb = layer_b.free_energy(params, v)
+    fg = layer_g.free_energy(params, v)
+    np.testing.assert_allclose(
+        float(fb),
+        float(jnp.mean(-v @ params["vb"]
+                       - jnp.sum(jax.nn.softplus(z), axis=-1))),
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        float(fg),
+        float(jnp.mean(-v @ params["vb"]
+                       - 0.5 * jnp.sum(z * z, axis=-1))),
+        rtol=1e-5)
+
+    layer_r, params_r = make("RECTIFIED")
+    with pytest.raises(NotImplementedError, match="RECTIFIED"):
+        layer_r.pretrain_loss(params_r, v, jax.random.PRNGKey(1))

@@ -1,0 +1,151 @@
+"""Zoo inference surface: ImageNet labels, decode-predictions, and the
+HTTP model-serving round trip (ref ImageNetLabels.java,
+TrainedModels.java decodePredictions, DL4jServeRouteBuilder.java)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf import InputType
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.zoo.util.imagenet import (
+    ImageNetLabels,
+    decode_predictions,
+)
+
+
+@pytest.fixture
+def class_index(tmp_path):
+    """A 6-class index file in the canonical
+    imagenet_class_index.json format."""
+    raw = {str(i): [f"n{i:08d}", name] for i, name in enumerate(
+        ["tench", "goldfish", "white_shark", "tiger_shark",
+         "hammerhead", "electric_ray"])}
+    p = tmp_path / "class_index.json"
+    p.write_text(json.dumps(raw))
+    return str(p)
+
+
+def test_imagenet_labels_lookup(class_index):
+    labels = ImageNetLabels(class_index)
+    assert len(labels) == 6
+    assert labels.get_label(0) == "tench"
+    assert labels.getLabel(4) == "hammerhead"   # camelCase parity
+    assert labels.get_wnid(1) == "n00000001"
+
+
+def test_decode_predictions_sorted_topk(class_index):
+    labels = ImageNetLabels(class_index)
+    preds = np.array([[0.05, 0.5, 0.1, 0.3, 0.03, 0.02],
+                      [0.9, 0.02, 0.02, 0.02, 0.02, 0.02]])
+    rows = labels.decode_predictions(preds, top=3)
+    assert [r[2] for r in rows[0]] == ["goldfish", "tiger_shark",
+                                       "white_shark"]
+    assert rows[0][0][3] == pytest.approx(0.5)
+    assert rows[1][0][2] == "tench"
+    # 1-D input treated as a single row; module-level fn agrees
+    single = decode_predictions(preds[0], top=1, labels=labels)
+    assert single[0][0][2] == "goldfish"
+
+
+def test_decode_predictions_str_format(class_index):
+    labels = ImageNetLabels(class_index)
+    preds = np.array([[0.6, 0.2, 0.1, 0.05, 0.03, 0.02]])
+    s = labels.decode_predictions_str(preds, top=2)
+    assert s.startswith("Predictions for batch  :")
+    assert "tench" in s and "%" in s
+    assert "goldfish" in s.splitlines()[2]
+
+
+def test_decode_predictions_class_count_mismatch(class_index):
+    labels = ImageNetLabels(class_index)
+    with pytest.raises(ValueError, match="classes"):
+        labels.decode_predictions(np.zeros((1, 10)))
+
+
+def _net(seed=7):
+    conf = (NeuralNetConfiguration.Builder().seed(seed).updater("sgd")
+            .learning_rate(0.1).activation("tanh").weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=16))
+            .layer(OutputLayer(n_out=6, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_model_server_round_trip(class_index):
+    from deeplearning4j_tpu.parallel.serving import ModelClient, ModelServer
+
+    net = _net()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4, 8)).astype(np.float32)
+    server = ModelServer(net, labels=ImageNetLabels(class_index)).start()
+    try:
+        client = ModelClient(f"http://127.0.0.1:{server.port}")
+        st = client.status()
+        assert st["inference_mode"] == "batched" and st["has_labels"]
+
+        resp = client.predict(x)
+        out = np.asarray(resp["outputs"], np.float32)
+        direct = np.asarray(net.output(x))
+        np.testing.assert_allclose(out, direct, rtol=1e-4, atol=1e-5)
+
+        # decoded top-k rides the same route (the zoo user surface)
+        resp = client.predict(x, decode_top=2)
+        assert len(resp["decoded"]) == 4
+        best = resp["decoded"][0][0]
+        assert best["class"] == int(np.argmax(direct[0]))
+        assert best["label"] == ImageNetLabels(class_index).get_label(
+            best["class"])
+        assert best["probability"] == pytest.approx(
+            float(direct[0].max()), rel=1e-4)
+    finally:
+        server.stop()
+
+
+def test_model_server_concurrent_clients(class_index):
+    """Concurrent small requests coalesce through ParallelInference and
+    every caller gets its own rows back."""
+    import concurrent.futures as cf
+
+    from deeplearning4j_tpu.parallel.serving import ModelClient, ModelServer
+
+    net = _net()
+    rng = np.random.default_rng(1)
+    inputs = [rng.normal(size=(2, 8)).astype(np.float32)
+              for _ in range(6)]
+    server = ModelServer(net).start()
+    try:
+        client = ModelClient(f"http://127.0.0.1:{server.port}")
+        with cf.ThreadPoolExecutor(6) as ex:
+            outs = list(ex.map(lambda a: client.predict(a)["outputs"],
+                               inputs))
+        for x, o in zip(inputs, outs):
+            np.testing.assert_allclose(
+                np.asarray(o, np.float32), np.asarray(net.output(x)),
+                rtol=1e-4, atol=1e-5)
+    finally:
+        server.stop()
+
+
+def test_model_server_error_paths(class_index):
+    import urllib.error
+    import urllib.request
+
+    from deeplearning4j_tpu.parallel.serving import ModelClient, ModelServer
+
+    server = ModelServer(_net()).start()   # no labels
+    try:
+        client = ModelClient(f"http://127.0.0.1:{server.port}")
+        with pytest.raises(urllib.error.HTTPError):
+            client.predict(np.zeros((1, 8), np.float32), decode_top=3)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/nope", data=b"{}",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(req, timeout=5)
+    finally:
+        server.stop()
