@@ -65,7 +65,7 @@ PEAK_FLOPS = {
 
 
 def bench_resnet50(batch=128, hw=224, iters=32, unroll=4,
-                   compute_dtype="bfloat16"):
+                   compute_dtype="bfloat16", bn_stat_sample=1):
     """Steady-state training-step throughput, batch resident on device.
 
     Runs the fused helper tier (nn/helpers) and `unroll` grad-over-flat
@@ -81,7 +81,7 @@ def bench_resnet50(batch=128, hw=224, iters=32, unroll=4,
     from __graft_entry__ import _flagship
 
     net, _, _ = _flagship(batch=batch, hw=hw, compute_dtype=compute_dtype,
-                          helpers="fused")
+                          helpers="fused", bn_stat_sample=bn_stat_sample)
     rng = np.random.default_rng(0)
     x = jax.device_put(jnp.asarray(
         rng.normal(size=(batch, hw, hw, 3)).astype(np.float32)))
@@ -144,18 +144,21 @@ def bench_resnet50(batch=128, hw=224, iters=32, unroll=4,
             [d / iters * 1e3 for d in dts])
 
 
-def bench_lstm(batch=64, seq_len=256, vocab=98, iters=30):
+def bench_lstm(batch=64, seq_len=256, vocab=98, iters=30, remat=False):
     """BASELINE config #3: GravesLSTM char-RNN tokens/sec
     (ref zoo/model/TextGenerationLSTM.java; LSTMHelpers.java:182,448).
-    Run with `python bench.py lstm`."""
+    Run with `python bench.py lstm [batch] [remat]`; remat recomputes
+    gates in BPTT (LSTM.bptt_remat — the cuDNN-LSTM tradeoff)."""
     import jax
     import jax.numpy as jnp
 
     from deeplearning4j_tpu.zoo import TextGenerationLSTM
 
-    net = TextGenerationLSTM(num_classes=vocab,
-                             input_shape=(seq_len, vocab),
-                             compute_dtype="bfloat16").init_model()
+    zm = TextGenerationLSTM(num_classes=vocab,
+                            input_shape=(seq_len, vocab),
+                            compute_dtype="bfloat16")
+    zm.bptt_remat = remat
+    net = zm.init_model()
     rng = np.random.default_rng(0)
     ids = rng.integers(0, vocab, (batch, seq_len))
     x = jax.device_put(jnp.asarray(
@@ -362,7 +365,8 @@ def main():
         return
     if len(sys.argv) > 1 and sys.argv[1] == "lstm":
         b = int(sys.argv[2]) if len(sys.argv) > 2 else 64
-        tps, step_s, loss, step_ms = bench_lstm(batch=b)
+        remat = len(sys.argv) > 3 and sys.argv[3] == "remat"
+        tps, step_s, loss, step_ms = bench_lstm(batch=b, remat=remat)
         print(json.dumps({
             "metric": "lstm_char_rnn_tokens_per_sec_per_chip",
             "value": round(tps, 1),
@@ -371,14 +375,18 @@ def main():
             "step_time_ms": round(step_s * 1e3, 1),
             "step_ms_spread": _spread(step_ms),
             "final_loss": round(loss, 3),
-            "config": f"batch={b} seq=256 vocab=98 2xLSTM(256)",
+            "config": f"batch={b} seq=256 vocab=98 2xLSTM(256)" + (" bptt_remat" if remat else ""),
             "device": str(dev.device_kind),
             "platform": str(dev.platform),
             "jax": jax.__version__,
         }))
         return
-    ips, step_s, loss, step_ms = bench_resnet50()
-    key = "resnet50_train_images_per_sec_per_chip"
+    ghost_k = 1
+    if len(sys.argv) > 1 and sys.argv[1] == "ghostbn":
+        ghost_k = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    ips, step_s, loss, step_ms = bench_resnet50(bn_stat_sample=ghost_k)
+    key = ("resnet50_train_images_per_sec_per_chip" if ghost_k == 1 else
+           "resnet50_ghostbn_train_images_per_sec_per_chip")
     base = BASELINES.get(key)
     vs = 1.0 if not base else ips / base
     peak = PEAK_FLOPS.get(dev.device_kind, 197e12)
@@ -396,7 +404,9 @@ def main():
         "step_ms_spread": _spread(step_ms),
         "approx_mfu": round(mfu, 3),
         "final_loss": round(loss, 3),
-        "config": "batch=128 bf16-mixed-precision 224x224",
+        "config": "batch=128 bf16-mixed-precision 224x224"
+                  + (f" ghost-bn stat_sample={ghost_k}"
+                     if ghost_k > 1 else ""),
         "device": str(dev.device_kind),
         "platform": str(dev.platform),
         "jax": jax.__version__,

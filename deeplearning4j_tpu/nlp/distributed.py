@@ -1,0 +1,186 @@
+"""Multi-host SequenceVectors training (the dl4j-spark-nlp role).
+
+Parity: deeplearning4j-scaleout/spark/dl4j-spark-nlp — Spark Word2Vec
+(spark/models/embeddings/word2vec/Word2Vec.java:1 — per-partition
+training + table averaging) and ParagraphVectors' distributed fit.
+
+TPU-native redesign: the reference ships sentence RDD partitions to
+workers, trains each partition against a broadcast vocab, and reduces
+the embedding tables. Here every process in a `jax.distributed` job
+builds the SAME vocab/init deterministically from the shared corpus
+(seeded — no broadcast needed), trains its corpus shard locally with
+the in-process SequenceVectors tiers (scan or dense slab-scan), and
+every `sync_every` epochs the processes exchange k-epoch TABLE DELTAS
+— mean-reduced exactly like LocalStepTrainer's local-SGD rendezvous
+(parallel/wrapper.py), including optional threshold compression with
+per-process residual carry (the GradientsAccumulator encoding,
+EncodingHandler.java:57-73 role) and the same wire accounting.
+
+The delta exchange runs through
+`jax.experimental.multihost_utils.process_allgather` — on real fleets
+that is a DCN collective; on the test rig it is the 2-subprocess
+rendezvous tests/test_nlp_distributed.py drives.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+
+class DistributedSequenceVectors:
+    """Data-parallel wrapper over a SequenceVectors instance.
+
+    Usage (one process per host, under jax.distributed):
+
+        sv = Word2Vec.Builder()...build()   # or SequenceVectors(...)
+        dsv = DistributedSequenceVectors(sv, sync_every=1)
+        dsv.build_vocab(corpus)             # full corpus, every process
+        dsv.fit(corpus)                     # trains THIS host's shard
+
+    `sync_every` is in epochs (the reference averages per Spark stage);
+    `threshold_compression` > 0 encodes each rendezvous delta as
+    sign(delta+residual)*thr with residual carry.
+    """
+
+    def __init__(self, sv, sync_every: int = 1,
+                 threshold_compression: float = 0.0,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None):
+        self.sv = sv
+        self.sync_every = max(1, int(sync_every))
+        self.threshold = float(threshold_compression)
+        self._pid = process_index
+        self._np = process_count
+        self._residual: Dict[str, np.ndarray] = {}
+        self._sent_nnz = 0
+        self._sent_total = 0
+        self._n_rendezvous = 0
+
+    # ------------------------------------------------------------ topology
+    def _topology(self):
+        if self._pid is not None and self._np is not None:
+            return self._pid, self._np
+        import jax
+
+        return jax.process_index(), jax.process_count()
+
+    @staticmethod
+    def shard_sequences(sequences: List[Sequence[str]], pid: int,
+                        nprocs: int) -> List[Sequence[str]]:
+        """Round-robin per-host partition (the RDD partition role);
+        deterministic so every process agrees without coordination."""
+        return list(sequences[pid::nprocs])
+
+    # ------------------------------------------------------------- vocab
+    def build_vocab(self, sequences: Iterable[Sequence[str]]):
+        """Full-corpus vocab on every process: with the shared seed the
+        init tables are bit-identical, which replaces the reference's
+        vocab broadcast."""
+        self.sv.build_vocab(sequences)
+        return self
+
+    # -------------------------------------------------------------- sync
+    def _tables(self) -> Dict[str, np.ndarray]:
+        out = {"syn0": self.sv.syn0}
+        if self.sv.syn1 is not None:
+            out["syn1"] = self.sv.syn1
+        if getattr(self.sv, "syn1neg", None) is not None:
+            out["syn1neg"] = self.sv.syn1neg
+        return {k: np.asarray(v, np.float32) for k, v in out.items()
+                if v is not None}
+
+    def _set_tables(self, tabs: Dict[str, np.ndarray]) -> None:
+        self.sv.syn0 = tabs["syn0"]
+        if "syn1" in tabs:
+            self.sv.syn1 = tabs["syn1"]
+        if "syn1neg" in tabs:
+            self.sv.syn1neg = tabs["syn1neg"]
+
+    def _encode(self, name: str, delta: np.ndarray) -> np.ndarray:
+        """Threshold-encode with residual carry (EncodingHandler role);
+        no-op when compression is off."""
+        if self.threshold <= 0.0:
+            return delta
+        res = self._residual.get(name)
+        if res is None:
+            res = np.zeros_like(delta)
+        acc = delta + res
+        send = np.where(np.abs(acc) >= self.threshold,
+                        np.sign(acc) * self.threshold, 0.0
+                        ).astype(np.float32)
+        self._residual[name] = acc - send
+        self._sent_nnz += int(np.count_nonzero(send))
+        self._sent_total += send.size
+        return send
+
+    def _allmean(self, deltas: Dict[str, np.ndarray]
+                 ) -> Dict[str, np.ndarray]:
+        pid, nprocs = self._topology()
+        if nprocs <= 1:
+            return deltas
+        from jax.experimental import multihost_utils
+
+        out = {}
+        for k, d in deltas.items():
+            gathered = np.asarray(
+                multihost_utils.process_allgather(d))
+            out[k] = gathered.mean(axis=0).astype(np.float32)
+        return out
+
+    def wire_stats(self) -> Dict[str, float]:
+        """Fraction of delta elements actually shipped at the
+        compressed rendezvous (LocalStepTrainer.wire_stats parity —
+        same "compression_ratio" key, parallel/wrapper.py:512)."""
+        if self._sent_total == 0:
+            return {"rendezvous": self._n_rendezvous,
+                    "compression_ratio": 1.0}
+        return {"rendezvous": self._n_rendezvous,
+                "compression_ratio": self._sent_nnz / self._sent_total}
+
+    # --------------------------------------------------------------- fit
+    def fit(self, sequences: Iterable[Sequence[str]],
+            epochs: Optional[int] = None):
+        """Train this process's shard; rendezvous every `sync_every`
+        epochs. Total epoch count comes from the wrapped model."""
+        seqs = list(sequences)
+        pid, nprocs = self._topology()
+        shard = self.shard_sequences(seqs, pid, nprocs)
+        if not shard:
+            shard = seqs[:1]    # degenerate corpora: keep SPMD in step
+        total = int(epochs if epochs is not None else self.sv.epochs)
+        saved = (self.sv.epochs, self.sv.lr_total_epochs)
+        # One GLOBAL anneal across all k-epoch chunks (not a per-chunk
+        # sawtooth): lr_total_epochs sets the decay denominator and the
+        # model's _lr_seen carry continues the numerator across fit()
+        # calls. One persistent RNG stream per process so chunks don't
+        # replay identical shuffles/negatives and shards decorrelate
+        # (the reference's workers draw from independent thread-local
+        # RNGs, SkipGram.java's nextRandom role).
+        if self.sv._fit_rng is None:
+            self.sv._fit_rng = np.random.default_rng(
+                self.sv.seed + 1 + 7919 * pid)
+        try:
+            self.sv.lr_total_epochs = total
+            self.sv._lr_seen = 0
+            done = 0
+            while done < total:
+                k = min(self.sync_every, total - done)
+                before = {n: t.copy() for n, t in self._tables().items()}
+                self.sv.epochs = k
+                self.sv.fit(shard)
+                after = self._tables()
+                deltas = {n: self._encode(n, after[n] - before[n])
+                          for n in after}
+                mean = self._allmean(deltas)
+                self._n_rendezvous += 1
+                self._set_tables({n: before[n] + mean[n] for n in mean})
+                done += k
+        finally:
+            self.sv.epochs, self.sv.lr_total_epochs = saved
+        return self
+
+    # ------------------------------------------------- query pass-through
+    def __getattr__(self, item):
+        return getattr(self.sv, item)

@@ -604,6 +604,20 @@ class SequenceVectors:
         self._dense = False
         self._dense_steps = None
         self._hs_tables = None
+        # External lr-schedule hooks for chunked/distributed drivers
+        # (nlp/distributed.py): lr_total_epochs overrides self.epochs
+        # in the linear-decay denominator and turns on the _lr_seen
+        # carry (the examples-seen numerator persists across fit()
+        # calls — counted AFTER subsampling, so chunked and unchunked
+        # anneals stay aligned even with sampling>0), so k-epoch fit()
+        # calls continue ONE global anneal instead of each decaying
+        # learning_rate->min and snapping back. _fit_rng, when set,
+        # persists the shuffle/negative-sampling stream across fit()
+        # calls (and decorrelates processes) instead of replaying
+        # seed+1 every call.
+        self.lr_total_epochs = 0
+        self._lr_seen = 0
+        self._fit_rng = None
 
     def _ensure_steps(self):
         if self._neg_step is not None or self._dense_steps is not None:
@@ -929,7 +943,7 @@ class SequenceVectors:
         idx_arrays = self._index_corpus(seqs)
         if not idx_arrays:
             return self
-        rng = np.random.default_rng(self.seed + 1)
+        rng = self._fit_rng or np.random.default_rng(self.seed + 1)
         W = 2 * self.window
 
         def take_dev(host_attr, dev_attr):
@@ -951,10 +965,12 @@ class SequenceVectors:
             hs_tabs = (jnp.asarray(pts), jnp.asarray(cds),
                        jnp.asarray(msk))
         per_pos = 1 if self.use_cbow else self.window
-        approx = max(1, sum(a.size for a in idx_arrays) * per_pos
-                     * self.epochs)
+        positions = sum(a.size for a in idx_arrays)
+        chunked = int(self.lr_total_epochs) > 0
+        total_ep = int(self.lr_total_epochs) or self.epochs
+        approx = max(1, positions * per_pos * total_ep)
         S = self._DENSE_SLAB
-        seen = 0
+        seen = self._lr_seen if chunked else 0
         for _ in range(self.epochs):
             arr, sid = self._subsample_flat(idx_arrays, rng)
             n = arr.size
@@ -1006,6 +1022,8 @@ class SequenceVectors:
                 tables = self._dispatch_slab(
                     tables, rows, lrs, W, hs_tabs)
                 seen += n_real
+        if chunked:
+            self._lr_seen = seen
         syn0, syn1, syn1neg = tables
         # Leave the tables device-resident: queries (similarity/
         # words_nearest) and serialization fetch lazily through the
@@ -1030,7 +1048,7 @@ class SequenceVectors:
             return self._fit_dense(seqs)
         import jax.numpy as jnp
 
-        rng = np.random.default_rng(self.seed + 1)
+        rng = self._fit_rng or np.random.default_rng(self.seed + 1)
         syn0 = jnp.asarray(self.syn0)
         syn1 = None if self.syn1 is None else jnp.asarray(self.syn1)
         syn1neg = (None if self.syn1neg is None
@@ -1039,9 +1057,11 @@ class SequenceVectors:
         # rough total example count for the linear lr decay: skip-gram
         # emits ~window pairs per position, CBOW one example per position
         per_pos = 1 if self.use_cbow else self.window
+        chunked = int(self.lr_total_epochs) > 0
+        total_ep = int(self.lr_total_epochs) or self.epochs
         approx_pairs = max(
-            1, sum(len(s) for s in seqs) * per_pos * self.epochs)
-        seen = 0
+            1, sum(len(s) for s in seqs) * per_pos * total_ep)
+        seen = self._lr_seen if chunked else 0
         gen = (self._gen_cbow_examples if self.use_cbow
                else self._gen_pairs)
         flush = self._flush_cbow if self.use_cbow else self._flush
@@ -1063,6 +1083,8 @@ class SequenceVectors:
                     syn0, syn1, syn1neg, buf_c, buf_x, rng, seen,
                     approx_pairs)
                 seen += len(buf_c)
+        if chunked:
+            self._lr_seen = seen
         self.syn0 = np.asarray(syn0)
         self.syn1 = None if syn1 is None else np.asarray(syn1)
         self.syn1neg = None if syn1neg is None else np.asarray(syn1neg)

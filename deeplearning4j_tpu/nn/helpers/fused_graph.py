@@ -168,6 +168,7 @@ def fused_forward(net, params, states, inputs, *, train, rng,
     including masks, preprocessors, and RNN carries."""
     plan: Plan = net._fusion_plan
     topo = net.topo
+    by_name = {n.name: n for n in topo}
     acts: Dict[str, object] = dict(inputs)
     virts: Dict[str, _Expr] = {}
     raws: Dict[str, object] = {}
@@ -203,9 +204,15 @@ def fused_forward(net, params, states, inputs, *, train, rng,
             (x, s1, t1) = e.terms[0]
             (x2, s2, t2) = e.terms[1] if len(e.terms) > 1 else (None,) * 3
             p = params[name]
+            # with_stats carries the BN consumer's stat_sample
+            # (1 = exact full-batch statistics, k>1 = ghost/sampled;
+            # clamped so stat_sample<=0 means exact, matching norm.py)
+            bn_layer = by_name[spec.bn_name].obj
+            stats_k = (max(1, int(getattr(bn_layer, "stat_sample", 1)))
+                       if train else 0)
             y, ssum, ssq, u = fused_conv(
                 x, p["W"], p["b"], s1, t1, x2, s2, t2,
-                spec.stride, spec.padding, e.relu, train, plan.impl)
+                spec.stride, spec.padding, e.relu, stats_k, plan.impl)
             raws[name] = y
             stats[name] = (ssum, ssq)
             if src not in acts and (e.relu or len(e.terms) > 1
@@ -223,7 +230,9 @@ def fused_forward(net, params, states, inputs, *, train, rng,
             if train:
                 ssum, ssq = stats[conv_src]
                 raw = raws[conv_src]
-                count = raw.shape[0] * raw.shape[1] * raw.shape[2]
+                k = int(getattr(layer, "stat_sample", 1))
+                nb = (raw.shape[0] - 1) // max(k, 1) + 1  # sampled rows
+                count = nb * raw.shape[1] * raw.shape[2]
                 scale, shift, mean, var = bn_affine(
                     gamma, beta, ssum, ssq, count, layer.eps)
                 if st is not None:

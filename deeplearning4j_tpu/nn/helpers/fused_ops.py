@@ -74,8 +74,12 @@ def fused_conv(x, w, b, scale, shift, x2, scale2, shift2,
 
     x/x2: [B,H,W,C] raw (pre-BN) inputs; scale*/shift*: [C] f32 affines
     (None = plain tensor); stride: (sh, sw); padding: lax padding
-    ('SAME'/'VALID'/explicit); relu: bool; with_stats: compute channel
-    statistics of y (train-mode BN needs them; eval mode passes False).
+    ('SAME'/'VALID'/explicit); relu: bool; with_stats: 0/False = no
+    channel statistics (eval), 1/True = statistics of the full y
+    (train-mode BN), k>1 = statistics of the leading ceil(B/k) batch
+    rows of y (ghost/sampled statistics —
+    BatchNormalization.stat_sample; the stats pass then reads 1/k of
+    the activation).
 
     Returns (y_raw [B,H,W,N], ssum [N] f32, ssq [N] f32, u). `u` is the
     post-activation tensor — callers that don't use it get it DCE'd by
@@ -96,7 +100,8 @@ def _fwd_impl(x, w, b, scale, shift, x2, scale2, shift2,
     if b is not None:
         y = y + b.astype(y.dtype)
     if with_stats:
-        yf = y.astype(jnp.float32)
+        ys = _stat_rows(y, int(with_stats))
+        yf = ys.astype(jnp.float32)
         ssum = jnp.sum(yf, axis=(0, 1, 2))
         ssq = jnp.sum(yf * yf, axis=(0, 1, 2))
     else:
@@ -104,6 +109,17 @@ def _fwd_impl(x, w, b, scale, shift, x2, scale2, shift2,
         ssum = jnp.zeros((n,), jnp.float32)
         ssq = jnp.zeros((n,), jnp.float32)
     return y, ssum, ssq, u
+
+
+def _stat_rows(y, k):
+    """Leading ceil(B/k) batch rows of y (k=1: y itself) — contiguous
+    so the slice stays inside XLA's conv-epilogue fusion (a strided
+    slice materializes a gather and loses ~40 ms/step on the
+    flagship)."""
+    if k <= 1:
+        return y
+    nb = (y.shape[0] - 1) // k + 1
+    return lax.slice(y, (0,) * y.ndim, (nb,) + tuple(y.shape[1:]))
 
 
 def _fused_conv_fwd(x, w, b, scale, shift, x2, scale2, shift2,
@@ -122,17 +138,29 @@ def _fused_conv_bwd(stride, padding, relu, with_stats, impl, res, cts):
     dtype = x.dtype
 
     if (impl == "pallas" and w.ndim == 4 and w.shape[:2] == (1, 1)
-            and tuple(stride) == (1, 1)):
+            and tuple(stride) == (1, 1) and int(with_stats) <= 1):
         return _bwd_pallas_1x1(x, w, b, scale, shift, x2, scale2, shift2,
                                y, dy, dssum, dssq, du_out, relu,
                                with_stats)
 
     # effective output cotangent: dy + statistics contributions (fused
-    # by XLA into the grad convolutions' operand reads)
+    # by XLA into the grad convolutions' operand reads). With sampled
+    # statistics (k>1) only the leading ghost-batch rows carry a
+    # statistics contribution; a tail zero-pad extends the 1/k-sized
+    # correction without re-reading the full y.
     ybar = dy
     if with_stats:
-        ybar = (ybar.astype(jnp.float32) + dssum
-                + 2.0 * y.astype(jnp.float32) * dssq).astype(dtype)
+        k = int(with_stats)
+        if k <= 1:
+            ybar = (ybar.astype(jnp.float32) + dssum
+                    + 2.0 * y.astype(jnp.float32) * dssq).astype(dtype)
+        else:
+            ys = _stat_rows(y, k)
+            corr = (dssum + 2.0 * ys.astype(jnp.float32) * dssq
+                    ).astype(dtype)
+            hi = y.shape[0] - ys.shape[0]
+            pad_cfg = [(0, hi, 0)] + [(0, 0, 0)] * (y.ndim - 1)
+            ybar = ybar + lax.pad(corr, jnp.zeros((), dtype), pad_cfg)
 
     # recompute u (never materialized in fwd residuals)
     u = _prologue(x, scale, shift, x2, scale2, shift2, relu)

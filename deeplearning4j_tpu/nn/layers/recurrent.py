@@ -61,6 +61,14 @@ class LSTM(BaseLayer):
     activation: Optional[str] = "tanh"
     gate_activation: str = "sigmoid"
     forget_gate_bias_init: float = 1.0
+    # Recompute gate pre-activations in the backward pass instead of
+    # saving the per-step gate stacks (the cuDNN-LSTM recompute
+    # tradeoff, LSTMHelpers.java:448's fwdPassOutputAsArrays role):
+    # BPTT then streams only the [T,B,H] h/c carries from HBM instead
+    # of several [T,B,4H] residual stacks. Costs one extra RW matmul
+    # per step in backward; wins when the saved-stack HBM traffic is
+    # the bottleneck (large B*T; PERF.md LSTM roofline).
+    bptt_remat: bool = False
 
     _peepholes: bool = False  # GravesLSTM flips this
 
@@ -132,6 +140,10 @@ class LSTM(BaseLayer):
             return (h, c), h
 
         xs = xw_t if mask_t is None else (xw_t, mask_t)
+        if self.bptt_remat:
+            # prevent_cse=False is safe under scan (each iteration is
+            # its own remat scope) and lets XLA fuse the recompute.
+            body = jax.checkpoint(body, prevent_cse=False)
         carry, hs = lax.scan(body, carry0, xs, reverse=reverse)
         return jnp.swapaxes(hs, 0, 1), carry        # back to [B, T, H]
 

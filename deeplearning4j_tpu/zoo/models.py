@@ -159,6 +159,8 @@ class TextGenerationLSTM(ZooModel):
 
     num_classes = 26          # vocab size
     input_shape = (50, 26)    # (maxLength, vocab)
+    bptt_remat = False        # recompute gates in BPTT (set before
+                              # init_model; see LSTM.bptt_remat)
 
     def conf(self):
         t, v = self.input_shape
@@ -167,8 +169,8 @@ class TextGenerationLSTM(ZooModel):
                 .learning_rate(self.learning_rate)
                 .activation("tanh").weight_init("xavier")
                 .list()
-                .layer(GravesLSTM(n_out=256))
-                .layer(GravesLSTM(n_out=256))
+                .layer(GravesLSTM(n_out=256, bptt_remat=self.bptt_remat))
+                .layer(GravesLSTM(n_out=256, bptt_remat=self.bptt_remat))
                 .layer(RnnOutputLayer(n_out=self.num_classes, loss="mcxent"))
                 .backprop_type("truncated_bptt")
                 .t_bptt_forward_length(50)

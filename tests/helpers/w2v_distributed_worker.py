@@ -1,0 +1,77 @@
+"""Multi-host SequenceVectors worker (tests/test_nlp_distributed.py).
+
+Launched as N subprocesses under jax.distributed; each trains its
+corpus shard via DistributedSequenceVectors and writes the final syn0
+table + wire stats to OUT_DIR.
+
+Usage: w2v_distributed_worker.py PID NPROCS PORT OUT_DIR
+           [--epochs N] [--sync-every N] [--threshold T]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def corpus():
+    """Deterministic two-cluster corpus: 'a*' words co-occur only with
+    'a*', 'b*' only with 'b*' — trained embeddings must separate the
+    clusters (the semantic-quality check)."""
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    A = [f"a{i}" for i in range(12)]
+    B = [f"b{i}" for i in range(12)]
+    seqs = []
+    for i in range(400):
+        pool = A if i % 2 == 0 else B
+        seqs.append(list(rng.choice(pool, size=12)))
+    return seqs
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("pid", type=int)
+    ap.add_argument("nprocs", type=int)
+    ap.add_argument("port")
+    ap.add_argument("out_dir")
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--sync-every", type=int, default=2)
+    ap.add_argument("--threshold", type=float, default=0.0)
+    args = ap.parse_args()
+
+    if args.nprocs > 1:
+        import jax
+
+        jax.distributed.initialize(f"127.0.0.1:{args.port}",
+                                   num_processes=args.nprocs,
+                                   process_id=args.pid)
+
+    import numpy as np
+
+    from deeplearning4j_tpu.nlp.distributed import (
+        DistributedSequenceVectors,
+    )
+    from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+
+    sv = SequenceVectors(layer_size=16, window=3, negative=4,
+                         epochs=args.epochs, seed=11, mode="scan")
+    dsv = DistributedSequenceVectors(
+        sv, sync_every=args.sync_every,
+        threshold_compression=args.threshold)
+    seqs = corpus()
+    dsv.build_vocab(seqs)
+    dsv.fit(seqs)
+
+    np.save(os.path.join(args.out_dir, f"syn0_{args.pid}.npy"), sv.syn0)
+    with open(os.path.join(args.out_dir, f"stats_{args.pid}.json"),
+              "w") as f:
+        json.dump(dsv.wire_stats(), f)
+    print("WORKER_OK", args.pid)
+
+
+if __name__ == "__main__":
+    main()

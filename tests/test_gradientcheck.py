@@ -188,3 +188,39 @@ def test_all_loss_functions(rng):
         _check([DenseLayer(n_out=5),
                 OutputLayer(n_out=2, loss=loss, activation=act)],
                InputType.feed_forward(3), x, y)
+
+
+def test_lstm_bptt_remat_gradcheck(rng):
+    """bptt_remat recomputes gates in backward; gradients must be
+    IDENTICAL to the saved-stack path (same math, different schedule)
+    and pass the numeric check (the cuDNN-LSTM recompute tradeoff,
+    LSTMHelpers.java:448)."""
+    x = rng.normal(size=(3, 6, 4))
+    y = np.stack([_cls(rng, 6, 3) for _ in range(3)])
+    _check([GravesLSTM(n_out=5, bptt_remat=True),
+            RnnOutputLayer(n_out=3, loss="mcxent")],
+           InputType.recurrent(4, 6), x, y, subset=40)
+
+    # exact agreement of analytic grads with/without remat
+    def grads(remat):
+        b = NeuralNetConfiguration.Builder().seed(3).updater("sgd") \
+            .learning_rate(0.1).activation("tanh") \
+            .weight_init("xavier").list() \
+            .layer(GravesLSTM(n_out=5, bptt_remat=remat)) \
+            .layer(RnnOutputLayer(n_out=3, loss="mcxent"))
+        conf = b.set_input_type(InputType.recurrent(4, 6)).build()
+        net = MultiLayerNetwork(conf, dtype=jnp.float64).init()
+        xs, ys = jnp.asarray(x), jnp.asarray(y)
+
+        def loss(params):
+            l, _ = net._loss_fn(params, net.states, xs, ys,
+                                None, None, None)
+            return l
+
+        return jax.grad(loss)(net.params)
+
+    ga, gb = grads(False), grads(True)
+    for a, b_ in zip(jax.tree_util.tree_leaves(ga),
+                     jax.tree_util.tree_leaves(gb)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-12, atol=1e-12)

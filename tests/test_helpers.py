@@ -229,3 +229,79 @@ def test_flat_chain_ineligible_configs(rng):
         assert FlatTrainChain.build(net) is None
     finally:
         net.topo[0].obj.frozen = False
+
+
+def _set_stat_sample(net, k):
+    from deeplearning4j_tpu.nn.layers.norm import BatchNormalization
+
+    for node in net.topo:
+        if node.kind == "layer" and isinstance(node.obj, BatchNormalization):
+            node.obj.stat_sample = k
+
+
+def test_ghost_bn_fused_matches_default(rng):
+    """stat_sample=2 (ghost/sampled statistics): the fused executor's
+    epilogue-sampled stats must match the default executor's leading-
+    ghost-batch stats — same loss, params, and running stats."""
+    x, y = _data(rng)
+    nets = {m: _mini_resnet(m) for m in ("none", "fused")}
+    for n in nets.values():
+        _set_stat_sample(n, 2)
+    for _ in range(3):
+        losses = {m: float(n.fit_batch(([x], [y])))
+                  for m, n in nets.items()}
+        np.testing.assert_allclose(losses["none"], losses["fused"],
+                                   rtol=5e-4)
+    sn = jax.tree_util.tree_leaves_with_path(nets["none"].states)
+    sf = jax.tree_util.tree_leaves(nets["fused"].states)
+    for (path, a), b in zip(sn, sf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=5e-5, err_msg=str(path))
+
+
+def test_ghost_bn_stats_are_sampled_rows(rng):
+    """The sampled statistics must equal full-batch statistics of the
+    SUBSAMPLE (definition check), and differ from full-batch stats."""
+    from deeplearning4j_tpu.nn.conf.inputs import InputType as IT
+    from deeplearning4j_tpu.nn.layers.norm import BatchNormalization
+
+    x = jnp.asarray(rng.normal(size=(8, 4, 4, 3)).astype(np.float32))
+    layer = BatchNormalization(stat_sample=2)
+    layer.set_n_in(IT.convolutional(4, 4, 3))
+    params = layer.init_params(jax.random.PRNGKey(0),
+                               IT.convolutional(4, 4, 3))
+    state = layer.init_state(IT.convolutional(4, 4, 3))
+    _, ns = layer.apply(params, x, train=True, state=state)
+    # EMA moved toward the subsample's stats (leading ghost batch)
+    sub = np.asarray(x)[:4]
+    m_sub = sub.mean(axis=(0, 1, 2))
+    m_full = np.asarray(x).mean(axis=(0, 1, 2))
+    d = layer.decay
+    np.testing.assert_allclose(np.asarray(ns["mean"]),
+                               (1 - d) * m_sub, rtol=1e-4, atol=1e-5)
+    assert not np.allclose(m_sub, m_full, atol=1e-5)
+
+
+def test_ghost_bn_gradcheck(rng):
+    """Numeric gradient check through sampled statistics (default
+    executor; exact autodiff through the subsample's mean/var)."""
+    from deeplearning4j_tpu import MultiLayerNetwork
+    from deeplearning4j_tpu.gradientcheck import check_gradients
+    from deeplearning4j_tpu.nn.layers import (
+        BatchNormalization,
+        ConvolutionLayer,
+    )
+
+    with jax.enable_x64(True):
+        b = (NeuralNetConfiguration.Builder().seed(3).updater("sgd")
+             .learning_rate(0.1).activation("tanh").weight_init("xavier")
+             .list()
+             .layer(ConvolutionLayer(n_out=3, kernel_size=(3, 3),
+                                     convolution_mode="same"))
+             .layer(BatchNormalization(stat_sample=2))
+             .layer(OutputLayer(n_out=4, loss="mcxent")))
+        conf = b.set_input_type(InputType.convolutional(6, 6, 2)).build()
+        net = MultiLayerNetwork(conf, dtype=jnp.float64).init()
+        x = rng.normal(size=(4, 6, 6, 2))
+        y = np.eye(4)[rng.integers(0, 4, 4)]
+        assert check_gradients(net, x, y, subset=40)
