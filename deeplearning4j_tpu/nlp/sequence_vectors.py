@@ -556,7 +556,7 @@ class SequenceVectors:
                  use_cbow: bool = False, seed: int = 42,
                  chunk: Optional[int] = None,
                  mode: Optional[str] = None,
-                 dense_batch_size: int = 32768):
+                 dense_batch_size: int = 16384):
         self.layer_size = layer_size
         self.window = window
         self.negative = negative
@@ -865,7 +865,10 @@ class SequenceVectors:
 
     # Slab size: batches per dispatch. One compiled scan shape per
     # model — epoch tails are neutralized with lr=0 batches rather than
-    # a second compile. 64 * 32768 * 7 ints ~ 59 MB device-resident.
+    # a second compile. 64 * 16384 * 7 int16 ~ 15 MB on the wire
+    # (measured optimum: batch 16384 beats 8k/32k/64k on v5e — small
+    # enough to keep the dedup sort cheap, large enough to fill the
+    # VPU; see PERF.md word2vec).
     _DENSE_SLAB = 64
 
     def _epoch_pack_chunk(self, arr, sid, rng, p0, p1):
@@ -898,10 +901,82 @@ class SequenceVectors:
                  self._host_negatives(rng, ctx)], axis=1)
         return np.stack([cen, ctx], axis=1).astype(np.int32)
 
+    # Pipelined host packing (the reference overlaps its VectorCalculations
+    # workers with the trainer thread, SkipGram.java:224's hot loop running
+    # on a thread pool; here the ONE packer thread runs the native epoch
+    # builders — ctypes releases the GIL — while the main thread keeps the
+    # async device queue fed, so pack / h2d / device scan overlap).
+    pipeline_packing = True
+    _PREFETCH_SLABS = 2
+
+    def _prefetched(self, gen):
+        """Drain `gen` on a daemon thread through a bounded queue (the
+        AsyncPrefetchThread pattern, datasets/iterators.py) when
+        pipeline_packing is on; otherwise pass it through inline.
+        Exceptions on the packer thread re-raise at the consumer."""
+        if not self.pipeline_packing:
+            return gen
+
+        import queue as _qm
+        import threading
+
+        q: _qm.Queue = _qm.Queue(maxsize=self._PREFETCH_SLABS)
+        DONE, ERR = object(), object()
+        stop = threading.Event()   # consumer gone: packer must not
+                                   # park forever on a full queue
+                                   # (AsyncDataSetIterator._start's
+                                   # timed-put pattern)
+
+        def put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.05)
+                    return True
+                except _qm.Full:
+                    continue
+            return False
+
+        def run():
+            try:
+                for item in gen:
+                    if not put(item):
+                        return
+                put(DONE)
+            except BaseException as e:   # re-raised below
+                put((ERR, e))
+
+        threading.Thread(target=run, daemon=True,
+                         name="w2v-slab-packer").start()
+
+        def drain():
+            try:
+                while True:
+                    item = q.get()
+                    if item is DONE:
+                        return
+                    if isinstance(item, tuple) and len(item) == 2 \
+                            and item[0] is ERR:
+                        raise item[1]
+                    yield item
+            finally:
+                stop.set()
+
+        return drain()
+
     def _dispatch_slab(self, tables, rows, lrs, W, hs_tabs):
         """Ship one [S*Bp, cols] row block + per-batch lrs and run the
-        scan-slab step(s). Returns updated tables."""
+        scan-slab step(s). Returns updated tables.
+
+        Rows may arrive as int16 (the halved wire format the packer
+        uses when the vocabulary fits — the h2d of the packed slabs is
+        the measured word2vec bottleneck on the dev tunnel); they are
+        widened back to int32 by a trivial on-device convert before
+        entering the compiled steps."""
         import jax.numpy as jnp
+
+        def ship(r):
+            d = jnp.asarray(r)
+            return d.astype(jnp.int32) if r.dtype != np.int32 else d
 
         syn0, syn1, syn1neg = tables
         S = lrs.size
@@ -910,22 +985,22 @@ class SequenceVectors:
         lrs_d = jnp.asarray(lrs)
         if self.use_cbow:
             if self.use_hs:
-                packs = jnp.asarray(np.ascontiguousarray(
+                packs = ship(np.ascontiguousarray(
                     rows[:, :W + 1]).reshape(S, Bp, W + 1))
                 syn0, syn1 = self._dense_steps.cbow_hs(
                     syn0, syn1, *hs_tabs, packs, W, lrs_d)
             if self.negative > 0:
-                packs = jnp.asarray(rows.reshape(S, Bp, cols))
+                packs = ship(rows.reshape(S, Bp, cols))
                 syn0, syn1neg = self._dense_steps.cbow_ns(
                     syn0, syn1neg, packs, W, lrs_d)
         else:
             if self.use_hs:
-                packs = jnp.asarray(np.ascontiguousarray(
+                packs = ship(np.ascontiguousarray(
                     rows[:, :2]).reshape(S, Bp, 2))
                 syn0, syn1 = self._dense_steps.sg_hs(
                     syn0, syn1, *hs_tabs, packs, lrs_d)
             if self.negative > 0:
-                packs = jnp.asarray(rows.reshape(S, Bp, cols))
+                packs = ship(rows.reshape(S, Bp, cols))
                 syn0, syn1neg = self._dense_steps.sg_ns(
                     syn0, syn1neg, packs, lrs_d)
         return syn0, syn1, syn1neg
@@ -934,10 +1009,12 @@ class SequenceVectors:
         """Streamed dense training: the corpus is processed in
         position-chunks whose packed rows accumulate in a host buffer;
         every full slab (fixed [S, Bp, cols] shape, ONE compile) ships
-        as a single scan dispatch, so chunk building overlaps device
-        compute. The epoch tail pads to the slab shape with wrap-around
-        rows; fully-padded batches get lr=0 (no update) instead of a
-        second compiled shape."""
+        as a single scan dispatch. With pipeline_packing (default) the
+        packing runs on a prefetch thread (double-buffered), so pack /
+        slab h2d / device scan genuinely overlap instead of
+        serializing. The epoch tail pads to the slab shape with
+        wrap-around rows; fully-padded batches get lr=0 (no update)
+        instead of a second compiled shape."""
         import jax.numpy as jnp
 
         idx_arrays = self._index_corpus(seqs)
@@ -970,60 +1047,85 @@ class SequenceVectors:
         total_ep = int(self.lr_total_epochs) or self.epochs
         approx = max(1, positions * per_pos * total_ep)
         S = self._DENSE_SLAB
-        seen = self._lr_seen if chunked else 0
-        for _ in range(self.epochs):
-            arr, sid = self._subsample_flat(idx_arrays, rng)
-            n = arr.size
-            if n == 0:
-                continue
-            Bp = self.dense_batch_size
-            slab_rows = S * Bp
-            # chunk sized to produce ~1.25 slabs of rows so the buffer
-            # drains about once per chunk
-            pos_chunk = max(1, int(slab_rows * 1.25 / max(per_pos, 1)))
-            buf: list = []
-            buffered = 0
-            first_rows = None
-            for a in range(0, n, pos_chunk):
-                pk = self._epoch_pack_chunk(
-                    arr, sid, rng, a, min(a + pos_chunk, n))
-                if first_rows is None and pk.shape[0]:
-                    first_rows = pk[:Bp].copy()
-                buf.append(pk)
-                buffered += pk.shape[0]
-                while buffered >= slab_rows:
-                    block = np.concatenate(buf, axis=0)
-                    rows, rest = block[:slab_rows], block[slab_rows:]
-                    buf, buffered = [rest], rest.shape[0]
+        seen0 = self._lr_seen if chunked else 0
+        # halved wire format: every packed value is a word index (or the
+        # -1 CBOW empty-slot sentinel), so a sub-32k vocabulary ships
+        # int16 rows and widens on device (h2d of the slabs is the
+        # measured bottleneck of this path on the dev tunnel)
+        wire_dt = (np.int16 if self.vocab.num_words() < 32768
+                   else np.int32)
+
+        def slabs():
+            """Host production pipeline: yields (rows, lrs, n_real)
+            fixed-shape slabs. Runs on the packer thread when
+            pipeline_packing is on — all rng use (subsample, pack,
+            negatives) lives here in the exact serial order, so the
+            pipelined and inline paths are bit-identical."""
+            seen = seen0
+            for _ in range(self.epochs):
+                arr, sid = self._subsample_flat(idx_arrays, rng)
+                n = arr.size
+                if n == 0:
+                    continue
+                Bp = self.dense_batch_size
+                slab_rows = S * Bp
+                # chunk sized to produce ~1.25 slabs of rows so the
+                # buffer drains about once per chunk
+                pos_chunk = max(1, int(slab_rows * 1.25
+                                       / max(per_pos, 1)))
+                buf: list = []
+                buffered = 0
+                first_rows = None
+                for a in range(0, n, pos_chunk):
+                    pk = self._epoch_pack_chunk(
+                        arr, sid, rng, a, min(a + pos_chunk, n))
+                    pk = pk.astype(wire_dt, copy=False)
+                    if first_rows is None and pk.shape[0]:
+                        first_rows = pk[:Bp].copy()
+                    buf.append(pk)
+                    buffered += pk.shape[0]
+                    while buffered >= slab_rows:
+                        block = np.concatenate(buf, axis=0)
+                        rows, rest = (block[:slab_rows],
+                                      block[slab_rows:])
+                        buf, buffered = [rest], rest.shape[0]
+                        lrs = np.asarray(
+                            [self._lr(seen + i * Bp, approx)
+                             for i in range(S)], np.float32)
+                        yield rows, lrs, slab_rows
+                        seen += slab_rows
+                # epoch tail: top up to the fixed slab shape; whole
+                # pad batches get lr=0, the boundary batch wraps
+                # epoch-head rows
+                rest = (np.concatenate(buf, axis=0) if buf
+                        else np.zeros((0, 2), wire_dt))
+                if rest.shape[0]:
+                    n_real = rest.shape[0]
+                    nb_real = -(-n_real // Bp)
+                    pad_src = (first_rows if first_rows is not None
+                               else rest)
+                    need = nb_real * Bp - n_real
+                    reps = (-(-need // max(pad_src.shape[0], 1))
+                            if need else 0)
+                    pad = (np.concatenate([pad_src] * reps,
+                                          axis=0)[:need]
+                           if reps else rest[:0])
+                    filler = np.zeros(
+                        ((S - nb_real) * Bp, rest.shape[1]), wire_dt)
+                    rows = np.concatenate([rest, pad, filler], axis=0)
                     lrs = np.asarray(
                         [self._lr(seen + i * Bp, approx)
-                         for i in range(S)], np.float32)
-                    tables = self._dispatch_slab(
-                        tables, rows, lrs, W, hs_tabs)
-                    seen += slab_rows
-            # epoch tail: top up to the fixed slab shape; whole pad
-            # batches get lr=0, the boundary batch wraps epoch-head rows
-            rest = (np.concatenate(buf, axis=0) if buf
-                    else np.zeros((0, 2), np.int32))
-            if rest.shape[0]:
-                n_real = rest.shape[0]
-                nb_real = -(-n_real // Bp)
-                pad_src = first_rows if first_rows is not None else rest
-                need = nb_real * Bp - n_real
-                reps = -(-need // max(pad_src.shape[0], 1)) if need else 0
-                pad = (np.concatenate([pad_src] * reps, axis=0)[:need]
-                       if reps else rest[:0])
-                filler = np.zeros(
-                    ((S - nb_real) * Bp, rest.shape[1]), np.int32)
-                rows = np.concatenate([rest, pad, filler], axis=0)
-                lrs = np.asarray(
-                    [self._lr(seen + i * Bp, approx) if i < nb_real
-                     else 0.0 for i in range(S)], np.float32)
-                tables = self._dispatch_slab(
-                    tables, rows, lrs, W, hs_tabs)
-                seen += n_real
+                         if i < nb_real else 0.0 for i in range(S)],
+                        np.float32)
+                    yield rows, lrs, n_real
+                    seen += n_real
+
+        seen_total = seen0
+        for rows, lrs, n_real in self._prefetched(slabs()):
+            tables = self._dispatch_slab(tables, rows, lrs, W, hs_tabs)
+            seen_total += n_real
         if chunked:
-            self._lr_seen = seen
+            self._lr_seen = seen_total
         syn0, syn1, syn1neg = tables
         # Leave the tables device-resident: queries (similarity/
         # words_nearest) and serialization fetch lazily through the
