@@ -13,11 +13,14 @@ path layouts by following each layer group's `weight_names` attr and
 falling back to a dataset walk.
 
 Layer mappings (reference table: KerasLayer.java):
-  InputLayer, Dense, Conv2D, MaxPooling2D, AveragePooling2D,
+  InputLayer, Dense, Conv2D, Conv1D, MaxPooling2D, AveragePooling2D,
   GlobalMaxPooling2D, GlobalAveragePooling2D, Flatten (auto CnnToFF
   preprocessor), Dropout, Activation, BatchNormalization, Embedding,
   LSTM, ZeroPadding2D, Add/Concatenate/... merge layers (functional
-  graphs), Loss (from training_config).
+  graphs), Loss (from training_config); LRN via the built-in custom
+  mapping (the KerasLRN role), and arbitrary custom layer classes via
+  `register_custom_layer` (the KerasLayer.registerCustomLayer role,
+  KerasLayer.java:261).
 
 Dim ordering: this framework is natively NHWC == TensorFlow
 channels_last, so Conv kernels (kh, kw, in, out) and Dense kernels
@@ -43,11 +46,13 @@ from deeplearning4j_tpu.nn.layers import (
     LSTM,
     ActivationLayer,
     BatchNormalization,
+    Convolution1DLayer,
     ConvolutionLayer,
     DenseLayer,
     DropoutLayer,
     EmbeddingLayer,
     GlobalPoolingLayer,
+    LocalResponseNormalization,
     OutputLayer,
     SubsamplingLayer,
     ZeroPaddingLayer,
@@ -210,6 +215,49 @@ def _read_archive(path: str):
 
 # ----------------------------------------------------------- layer mapping
 
+# Custom-layer registration (the KerasLayer.registerCustomLayer role —
+# KerasLayer.java:261 throws on unknown types unless a custom mapping
+# was registered; the reference ships KerasLRN/KerasPoolHelper as
+# built-in customs for Caffe-converted models).
+_CUSTOM_LAYERS: Dict[str, Tuple[Any, Any]] = {}
+
+
+def register_custom_layer(class_name: str, mapper,
+                          weight_mapper=None) -> None:
+    """Register an import mapping for a custom Keras layer class.
+
+    mapper(cfg, is_output=..., loss=...) must return a framework layer
+    (or 'flatten' / None skip markers, like _map_layer). Optional
+    weight_mapper(layer, weights_dict) -> (params, state) overrides the
+    built-in weight copy for layers the mapper returns."""
+    _CUSTOM_LAYERS[class_name] = (mapper, weight_mapper)
+
+
+def unregister_custom_layer(class_name: str) -> None:
+    _CUSTOM_LAYERS.pop(class_name, None)
+
+
+def _map_lrn(cfg: dict, *, is_output: bool, loss: Optional[str]):
+    """Built-in custom mapping for LRN layers from Caffe-converted
+    models (the KerasLRN role). Accepts both Caffe-ish (k/n/alpha/beta)
+    and tf.nn.local_response_normalization (bias/depth_radius) naming."""
+    if "n" in cfg:
+        n = int(cfg["n"])            # full window (Caffe naming)
+    elif "depth_radius" in cfg:
+        n = 2 * int(cfg["depth_radius"]) + 1   # radius -> window
+    else:
+        n = 5
+    return LocalResponseNormalization(
+        k=float(cfg.get("k", cfg.get("bias", 2.0))),
+        n=n,
+        alpha=float(cfg.get("alpha", 1e-4)),
+        beta=float(cfg.get("beta", 0.75)))
+
+
+register_custom_layer("LRN", _map_lrn)
+register_custom_layer("LocalResponseNormalization", _map_lrn)
+
+
 def _map_layer(cls: str, cfg: dict, *, is_output: bool, loss: Optional[str]):
     """Return a framework layer, 'flatten' (skip marker), or None (skip).
 
@@ -232,6 +280,28 @@ def _map_layer(cls: str, cfg: dict, *, is_output: bool, loss: Optional[str]):
             stride=(sh, sw), dilation=(dh, dw),
             convolution_mode="same" if same else "truncate",
             padding=(0, 0),
+            activation=_map_activation(cfg.get("activation")))
+    if cls in ("Conv1D", "Convolution1D"):
+        _check_channels_last(cfg, cls)
+        pad = cfg.get("padding", "valid")
+        if pad == "causal":
+            raise KerasImportError(
+                "Conv1D padding='causal' is not supported (no "
+                "reference counterpart; pre-pad with ZeroPadding1D)")
+        d = cfg.get("dilation_rate", 1)
+        d = d[0] if isinstance(d, (list, tuple)) else d
+        if int(d) != 1 or int(cfg.get("groups", 1)) != 1:
+            raise KerasImportError(
+                "Conv1D with dilation_rate/groups != 1 has no "
+                "Convolution1DLayer counterpart")
+        k = cfg.get("kernel_size", 3)
+        k = int(k[0]) if isinstance(k, (list, tuple)) else int(k)
+        s = cfg.get("strides", 1)
+        s = int(s[0]) if isinstance(s, (list, tuple)) else int(s)
+        return Convolution1DLayer(
+            n_out=int(cfg["filters"]), kernel_size=k, stride=s,
+            convolution_mode="same" if pad == "same" else "truncate",
+            padding=0,
             activation=_map_activation(cfg.get("activation")))
     if cls in ("MaxPooling2D", "AveragePooling2D"):
         _check_channels_last(cfg, cls)
@@ -287,9 +357,20 @@ def _map_layer(cls: str, cfg: dict, *, is_output: bool, loss: Optional[str]):
         return ZeroPaddingLayer(padding=(ph, pw))
     if cls == "InputLayer":
         return None
+    # keras-3 registered custom classes serialize as "package>Name";
+    # match both the qualified and the bare class name
+    bare = cls.rsplit(">", 1)[-1]
+    if cls in _CUSTOM_LAYERS or bare in _CUSTOM_LAYERS:
+        mapper, wmap = _CUSTOM_LAYERS.get(cls) or _CUSTOM_LAYERS[bare]
+        layer = mapper(cfg, is_output=is_output, loss=loss)
+        if wmap is not None and layer is not None \
+                and not isinstance(layer, str):
+            layer._keras_weight_mapper = wmap
+        return layer
     raise KerasImportError(
         f"Unsupported Keras layer type '{cls}' "
-        "(ref KerasLayer.java supported-type table)")
+        "(ref KerasLayer.java:261 supported-type table; register a "
+        "mapping with modelimport.keras.register_custom_layer)")
 
 
 _MERGE_CLASSES = {"Add": "add", "Subtract": "subtract",
@@ -309,6 +390,15 @@ def _reorder_lstm(k: np.ndarray, H: int) -> np.ndarray:
 def _params_from_keras(layer, w: Dict[str, np.ndarray]):
     """Map a keras layer's weight dict onto (params, state) for `layer`."""
     dt = jnp.float32
+    wmap = getattr(layer, "_keras_weight_mapper", None)
+    if wmap is not None:
+        return wmap(layer, w)
+    if isinstance(layer, Convolution1DLayer):
+        # keras Conv1D kernel [k, Cin, Cout] == ours, no transposition
+        return ({"W": jnp.asarray(w["kernel"], dt),
+                 "b": jnp.asarray(
+                     w.get("bias", np.zeros(w["kernel"].shape[-1])), dt)},
+                None)
     if isinstance(layer, (DenseLayer, OutputLayer)):
         return ({"W": jnp.asarray(w["kernel"], dt),
                  "b": jnp.asarray(w.get("bias",

@@ -100,6 +100,56 @@ def main():
     np.savez(os.path.join(HERE, "lstm_encoder_expected.npz"),
              x=xe, y=em.predict(xe, verbose=0))
 
+    # 6. Conv1D temporal stack (r5: importer Conv1D mapping)
+    c1 = keras.Sequential([
+        keras.Input((20, 6)),
+        layers.Conv1D(8, 3, activation="relu", padding="same", name="t1"),
+        layers.Conv1D(5, 3, strides=2, padding="valid", name="t2"),
+        layers.GlobalMaxPooling1D(name="gp"),
+        layers.Dense(4, activation="softmax", name="hd"),
+    ])
+    c1.compile(loss="categorical_crossentropy", optimizer="sgd")
+    x1 = rng.normal(size=(4, 20, 6)).astype(np.float32)
+    c1.save(os.path.join(HERE, "conv1d_stack.h5"))
+    np.savez(os.path.join(HERE, "conv1d_stack_expected.npz"),
+             x=x1, y=c1.predict(x1, verbose=0))
+
+    # 7. Custom LRN layer (r5: the KerasLRN built-in custom mapping).
+    #    tf.nn.local_response_normalization(depth_radius=n//2, bias=k)
+    #    == this framework's LocalResponseNormalization(n, k) window.
+    import keras as k3
+    import tensorflow as tf
+
+    @k3.saving.register_keras_serializable()
+    class LRN(layers.Layer):
+        def __init__(self, n=5, alpha=1e-4, beta=0.75, k=2.0, **kw):
+            super().__init__(**kw)
+            self.n, self.alpha, self.beta, self.k = n, alpha, beta, k
+
+        def call(self, x):
+            return tf.nn.local_response_normalization(
+                x, depth_radius=self.n // 2, bias=self.k,
+                alpha=self.alpha, beta=self.beta)
+
+        def get_config(self):
+            c = super().get_config()
+            c.update(n=self.n, alpha=self.alpha, beta=self.beta,
+                     k=self.k)
+            return c
+
+    lr = keras.Sequential([
+        keras.Input((8, 8, 3)),
+        layers.Conv2D(4, 3, activation="relu", name="lc1"),
+        LRN(n=5, alpha=2e-4, beta=0.75, k=1.5, name="lrn1"),
+        layers.Flatten(name="lf"),
+        layers.Dense(3, activation="softmax", name="lo"),
+    ])
+    lr.compile(loss="categorical_crossentropy", optimizer="sgd")
+    xr = rng.normal(size=(4, 8, 8, 3)).astype(np.float32)
+    lr.save(os.path.join(HERE, "lrn_cnn.h5"))
+    np.savez(os.path.join(HERE, "lrn_cnn_expected.npz"),
+             x=xr, y=lr.predict(xr, verbose=0))
+
     print("fixtures written to", HERE)
 
 
