@@ -56,6 +56,7 @@ def _spread(per_step_ms):
 
 # ResNet50 fwd ~= 4.09 GFLOPs/image @224; train ~= 3x fwd.
 RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 4.09e9
+VGG16_TRAIN_FLOPS_PER_IMAGE = 3 * 15.5e9
 PEAK_FLOPS = {
     # bf16 peak per chip
     "TPU v5 lite": 197e12,   # v5e
@@ -329,7 +330,11 @@ def main():
         }))
         return
     if len(sys.argv) > 1 and sys.argv[1] == "vgg16":
-        (dt_frozen, frozen_ms), (dt_full, full_ms), b = bench_vgg16()
+        vb = int(sys.argv[2]) if len(sys.argv) > 2 else 32
+        (dt_frozen, frozen_ms), (dt_full, full_ms), b = bench_vgg16(
+            batch=vb, iters=max(4, 256 // vb))
+        vgg_mfu = (b / dt_full) * VGG16_TRAIN_FLOPS_PER_IMAGE \
+            / PEAK_FLOPS.get(dev.device_kind, 197e12)
         print(json.dumps({
             "metric": "vgg16_finetune_224_images_per_sec_per_chip",
             "value": round(b / dt_full, 1),
@@ -340,7 +345,9 @@ def main():
             "frozen_step_ms": round(dt_frozen * 1e3, 1),
             "frozen_step_ms_spread": _spread(frozen_ms),
             "frozen_images_per_sec": round(b / dt_frozen, 1),
-            "config": f"batch={b} bf16 224x224 canonical keras VGG16",
+            "approx_mfu": round(vgg_mfu, 3),
+            "config": f"batch={b} bf16 224x224 canonical keras VGG16 "
+                      "(b256+: ~30% MFU, see PERF.md)",
             "device": str(dev.device_kind),
             "platform": str(dev.platform),
             "jax": jax.__version__,
