@@ -313,3 +313,54 @@ def test_word2vec_binary_serialization_round_trip(tmp_path):
     for w in ("cat", "dog", "cpu"):
         np.testing.assert_allclose(loaded.get_word_vector(w),
                                    w2v.get_word_vector(w), atol=1e-6)
+
+
+def test_dense_pipelined_packing_bit_identical():
+    """pipeline_packing (r5: packer thread + bounded queue) must be
+    bit-identical to the inline path — the rng lives on the producer
+    in serial order, so threading changes scheduling, not results."""
+    from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+
+    sents, _, _ = _corpus()
+    seqs = [s.split() for s in sents]
+
+    def run(pipelined):
+        sv = SequenceVectors(layer_size=16, window=3, negative=4,
+                             epochs=3, seed=5, mode="dense",
+                             dense_batch_size=128)
+        sv.pipeline_packing = pipelined
+        sv.build_vocab(seqs)
+        sv.fit(seqs)
+        return np.asarray(sv.syn0), np.asarray(sv.syn1neg)
+
+    s0a, s1a = run(True)
+    s0b, s1b = run(False)
+    np.testing.assert_array_equal(s0a, s0b)
+    np.testing.assert_array_equal(s1a, s1b)
+
+
+def test_dense_int16_wire_trains_and_queries():
+    """The sub-32k-vocab int16 wire format (r5) actually ships int16
+    rows, and the fitted tables stay finite and queryable."""
+    from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+
+    sents, _, _ = _corpus()
+    seqs = [s.split() for s in sents]
+    sv = SequenceVectors(layer_size=16, window=3, negative=4,
+                         epochs=2, seed=5, mode="dense",
+                         dense_batch_size=128)
+    sv.build_vocab(seqs)
+    assert sv.vocab.num_words() < 32768   # int16 wire precondition
+    shipped = []
+    orig = sv._dispatch_slab
+
+    def spy(tables, rows, lrs, W, hs_tabs):
+        shipped.append(rows.dtype)
+        return orig(tables, rows, lrs, W, hs_tabs)
+
+    sv._dispatch_slab = spy
+    sv.fit(seqs)
+    assert shipped and all(dt == np.int16 for dt in shipped), shipped
+    assert np.all(np.isfinite(np.asarray(sv.syn0)))
+    assert np.all(np.isfinite(np.asarray(sv.syn1neg)))
+    assert np.isfinite(sv.similarity("cat", "dog"))
