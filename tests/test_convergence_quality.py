@@ -39,7 +39,7 @@ def _dataset():
     rng = np.random.default_rng(42)
     n = N_TRAIN + N_TEST
     labels = rng.integers(0, CLASSES, n)
-    x = rng.normal(0, 0.35, size=(n, HW, HW, 1)).astype(np.float32)
+    x = rng.normal(0, 1.1, size=(n, HW, HW, 1)).astype(np.float32)
     for i, c in enumerate(labels):
         if c == 0:
             x[i, HW // 2 - 1:HW // 2 + 1, :, 0] += 1.0     # horizontal
@@ -56,7 +56,7 @@ def _dataset():
 
 def _lenet():
     conf = (NeuralNetConfiguration.Builder().seed(7).updater("adam")
-            .learning_rate(2e-3).activation("relu").weight_init("xavier")
+            .learning_rate(1e-3).activation("relu").weight_init("xavier")
             .list()
             .layer(ConvolutionLayer(n_out=8, kernel_size=(3, 3),
                                     convolution_mode="same"))
