@@ -40,7 +40,12 @@ class InMemoryModelSaver:
 
 
 class LocalFileModelSaver:
-    """Zip-based best/latest checkpoints in a directory."""
+    """Zip-based best/latest checkpoints in a directory.
+
+    Writes ride model_serializer.write_model's crash-safe path (tmp +
+    fsync + os.replace, sha256 sidecar), so a kill mid-save never
+    clobbers the previous best/latest model; loads surface a torn file
+    as CheckpointIntegrityError instead of silently restoring garbage."""
 
     def __init__(self, directory):
         self.directory = str(directory)
@@ -57,15 +62,25 @@ class LocalFileModelSaver:
         from deeplearning4j_tpu.util.model_serializer import write_model
         write_model(net, self._path("latest"))
 
-    def get_best_model(self, like_net=None):
+    def _load(self, tag):
+        from deeplearning4j_tpu.resilience.errors import (
+            CheckpointIntegrityError,
+        )
         from deeplearning4j_tpu.util.model_guesser import ModelGuesser
-        p = self._path("best")
-        return ModelGuesser.load_model_guess(p) if os.path.exists(p) else None
+        from deeplearning4j_tpu.util.model_serializer import verify_model
+        p = self._path(tag)
+        if not os.path.exists(p):
+            return None
+        if not verify_model(p):
+            raise CheckpointIntegrityError(
+                f"{p} failed sha256 validation (truncated or torn write?)")
+        return ModelGuesser.load_model_guess(p)
+
+    def get_best_model(self, like_net=None):
+        return self._load("best")
 
     def get_latest_model(self, like_net=None):
-        from deeplearning4j_tpu.util.model_guesser import ModelGuesser
-        p = self._path("latest")
-        return ModelGuesser.load_model_guess(p) if os.path.exists(p) else None
+        return self._load("latest")
 
 
 # graph models serialize identically

@@ -10,16 +10,33 @@ the mesh, so SEQUENTIAL degenerates to direct calls; the valuable part is
 BATCHED mode — coalescing concurrent small requests into one padded
 batch so the MXU runs full tiles. Batch sizes are bucketed to powers of
 two to bound XLA recompilation.
+
+Graceful degradation (resilience subsystem): the request queue is
+bounded and `output()` sheds load with OverloadedError instead of
+blocking when it fills; every wait carries a deadline so a dead batcher
+thread surfaces as InferenceUnavailableError rather than a hang;
+`shutdown()` fails fast — queued and pending requests are signaled with
+ShutdownError, and the front-end reports itself unhealthy via
+`healthy` (the /healthz source of truth in serving.py).
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import List, Optional
 
 import jax.numpy as jnp
 import numpy as np
+
+from deeplearning4j_tpu.resilience.errors import (
+    DeadlineExceededError,
+    InferenceUnavailableError,
+    OverloadedError,
+    ShutdownError,
+)
+from deeplearning4j_tpu.resilience.faults import fire as _fire
 
 
 class InferenceMode:
@@ -35,23 +52,32 @@ class _Pending:
         self.event = threading.Event()
         self.result = None
 
+    def resolve(self, result):
+        self.result = result
+        self.event.set()
+
 
 class ParallelInference:
     """Thread-safe inference front-end over a trained network.
 
-    Builder parity: workers ~ mesh size (implicit), batch_limit, queue_limit.
-    """
+    Builder parity: workers ~ mesh size (implicit), batch_limit,
+    queue_limit. `default_timeout_s` bounds every `output()` call
+    (per-call override via the `timeout_s` kwarg)."""
 
     def __init__(self, net, inference_mode: str = InferenceMode.BATCHED,
                  batch_limit: int = 32, queue_limit: int = 64,
-                 max_wait_ms: float = 2.0):
+                 max_wait_ms: float = 2.0,
+                 default_timeout_s: float = 30.0):
         self.net = net
         self.mode = inference_mode
         self.batch_limit = batch_limit
         self.max_wait_ms = max_wait_ms
+        self.default_timeout_s = default_timeout_s
         self._queue: "queue.Queue[_Pending]" = queue.Queue(maxsize=queue_limit)
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        self._shutdown = False
+        self._failure: Optional[BaseException] = None
         self._worker: Optional[threading.Thread] = None
         if self.mode == InferenceMode.BATCHED:
             self._worker = threading.Thread(
@@ -60,22 +86,94 @@ class ParallelInference:
             self._worker.start()
 
     # ------------------------------------------------------------------
-    def output(self, x) -> np.ndarray:
+    @property
+    def healthy(self) -> bool:
+        """False once shut down or the batcher thread has died."""
+        if self._shutdown or self._failure is not None:
+            return False
+        if self.mode == InferenceMode.BATCHED:
+            return self._worker is not None and self._worker.is_alive()
+        return True
+
+    def queue_depth(self) -> int:
+        return self._queue.qsize()
+
+    def _check_available(self):
+        if self._shutdown:
+            raise ShutdownError("ParallelInference is shut down")
+        if self._failure is not None:
+            raise InferenceUnavailableError(
+                f"batcher thread died: {self._failure!r}")
+        if (self.mode == InferenceMode.BATCHED
+                and (self._worker is None or not self._worker.is_alive())):
+            raise InferenceUnavailableError("batcher thread is not running")
+
+    def output(self, x, timeout_s: Optional[float] = None) -> np.ndarray:
+        """Run inference; raises OverloadedError when the bounded queue
+        is full (shed load, don't queue unbounded latency) and
+        DeadlineExceededError / InferenceUnavailableError instead of
+        hanging when the batcher stalls or dies."""
         x = np.asarray(x)
+        if timeout_s is None:
+            timeout_s = self.default_timeout_s
         if self.mode == InferenceMode.SEQUENTIAL:
+            self._check_available()
             with self._lock:
                 return np.asarray(self.net.output(x))
+        self._check_available()
         p = _Pending(x)
-        self._queue.put(p)
-        p.event.wait()
+        try:
+            self._queue.put_nowait(p)
+        except queue.Full:
+            raise OverloadedError(
+                f"inference queue full ({self._queue.maxsize} waiting); "
+                "retry later") from None
+        deadline = time.monotonic() + timeout_s
+        # poll in slices: a batcher that dies *after* the put but before
+        # its own drain would otherwise strand this waiter
+        while not p.event.wait(timeout=min(
+                0.05, max(0.0, deadline - time.monotonic()))):
+            if p.event.is_set():
+                break
+            if self._failure is not None or self._shutdown or (
+                    self._worker is not None
+                    and not self._worker.is_alive()):
+                self._drain(self._unavailable_error())
+                if not p.event.is_set():
+                    p.resolve(self._unavailable_error())
+            elif time.monotonic() >= deadline:
+                raise DeadlineExceededError(
+                    f"inference did not complete within {timeout_s}s")
         if isinstance(p.result, Exception):
             raise p.result
         return p.result
 
+    def _unavailable_error(self) -> Exception:
+        if self._shutdown and self._failure is None:
+            return ShutdownError(
+                "ParallelInference shut down with requests in flight")
+        return InferenceUnavailableError(
+            f"batcher thread died: {self._failure!r}")
+
     def shutdown(self):
+        """Fail fast: stop the batcher, then signal every queued request
+        with ShutdownError so no caller is left hanging."""
+        self._shutdown = True
         self._stop.set()
         if self._worker is not None:
-            self._worker.join(timeout=1.0)
+            self._worker.join(timeout=2.0)
+        self._drain(ShutdownError(
+            "ParallelInference shut down with requests in flight"))
+
+    def _drain(self, error: Exception):
+        """Signal everything still queued with `error`."""
+        while True:
+            try:
+                p = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if not p.event.is_set():
+                p.resolve(error)
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -86,42 +184,54 @@ class ParallelInference:
         return b
 
     def _batch_loop(self):
-        while not self._stop.is_set():
-            try:
-                first = self._queue.get(timeout=0.05)
-            except queue.Empty:
-                continue
-            pending: List[_Pending] = [first]
-            rows = first.x.shape[0]
-            deadline = self.max_wait_ms / 1000.0
-            import time
-            t0 = time.monotonic()
-            while rows < self.batch_limit:
-                remaining = deadline - (time.monotonic() - t0)
-                if remaining <= 0:
-                    break
+        try:
+            while not self._stop.is_set():
+                # chaos hook: a 'raise' here kills the batcher thread —
+                # the graceful-degradation drill for the serving path
+                _fire("inference.batch")
                 try:
-                    p = self._queue.get(timeout=remaining)
+                    first = self._queue.get(timeout=0.05)
                 except queue.Empty:
-                    break
-                pending.append(p)
-                rows += p.x.shape[0]
-            try:
-                big = np.concatenate([p.x for p in pending], axis=0)
-                bucket = self._bucket(big.shape[0])
-                if bucket > big.shape[0]:
-                    pad = np.zeros((bucket - big.shape[0],) + big.shape[1:],
-                                   big.dtype)
-                    big = np.concatenate([big, pad], axis=0)
-                with self._lock:
-                    out = np.asarray(self.net.output(jnp.asarray(big)))
-                ofs = 0
-                for p in pending:
-                    n = p.x.shape[0]
-                    p.result = out[ofs:ofs + n]
-                    ofs += n
-                    p.event.set()
-            except Exception as e:  # propagate to callers
-                for p in pending:
-                    p.result = e
-                    p.event.set()
+                    continue
+                pending: List[_Pending] = [first]
+                rows = first.x.shape[0]
+                deadline = self.max_wait_ms / 1000.0
+                t0 = time.monotonic()
+                while rows < self.batch_limit:
+                    remaining = deadline - (time.monotonic() - t0)
+                    if remaining <= 0:
+                        break
+                    try:
+                        p = self._queue.get(timeout=remaining)
+                    except queue.Empty:
+                        break
+                    pending.append(p)
+                    rows += p.x.shape[0]
+                try:
+                    big = np.concatenate([p.x for p in pending], axis=0)
+                    bucket = self._bucket(big.shape[0])
+                    if bucket > big.shape[0]:
+                        pad = np.zeros(
+                            (bucket - big.shape[0],) + big.shape[1:],
+                            big.dtype)
+                        big = np.concatenate([big, pad], axis=0)
+                    with self._lock:
+                        out = np.asarray(self.net.output(jnp.asarray(big)))
+                    ofs = 0
+                    for p in pending:
+                        n = p.x.shape[0]
+                        p.resolve(out[ofs:ofs + n])
+                        ofs += n
+                except Exception as e:  # per-batch: propagate to callers
+                    for p in pending:
+                        p.resolve(e)
+        except BaseException as e:   # noqa: BLE001 - loop-level death
+            # batcher death is a degradation event, not a hang: record
+            # it (flips `healthy` and /healthz), then fail every waiter
+            self._failure = e
+        finally:
+            if self._failure is not None:
+                self._drain(self._unavailable_error())
+            elif self._stop.is_set():
+                self._drain(ShutdownError(
+                    "ParallelInference shut down with requests in flight"))

@@ -13,6 +13,15 @@ Routes:
   POST /predict  {"inputs": ..., "decode_top": 5}  -> adds "decoded"
                  (requires an ImageNetLabels source; zoo/util/imagenet)
   GET  /status   -> model + queue facts
+  GET  /healthz  -> liveness: 200 while the batcher is alive, 503 after
+                 it dies or the server shuts down
+  GET  /readyz   -> readiness: 200 only while accepting traffic
+
+Failure taxonomy (resilience subsystem) instead of blanket 400:
+  404 unknown route - 400 malformed payload / client error
+  503 + Retry-After overload, shutdown, or dead batcher
+  500 model/handler crash
+Every error body is {"error": msg, "error_class": ExceptionName}.
 
 Requests are funneled through ParallelInference in BATCHED mode, so
 concurrent small clients coalesce into full MXU tiles (the reference's
@@ -31,6 +40,23 @@ from deeplearning4j_tpu.parallel.inference import (
     InferenceMode,
     ParallelInference,
 )
+from deeplearning4j_tpu.resilience.errors import (
+    DeadlineExceededError,
+    InferenceUnavailableError,
+    OverloadedError,
+    ServingError,
+    ShutdownError,
+)
+from deeplearning4j_tpu.resilience.faults import fire as _fire
+from deeplearning4j_tpu.resilience.retry import Retry
+
+# errors that mean "back off and retry": surfaced as 503 + Retry-After
+_UNAVAILABLE = (OverloadedError, ShutdownError, InferenceUnavailableError,
+                DeadlineExceededError)
+
+
+class _ClientError(ValueError):
+    """Request was malformed — maps to HTTP 400."""
 
 
 class ModelServer:
@@ -54,7 +80,45 @@ class ModelServer:
         self._thread: Optional[threading.Thread] = None
         self._served = 0
         self._served_lock = threading.Lock()
+        self._ready = False
 
+    # ------------------------------------------------------------ handlers
+    def _handle_predict(self, req: dict) -> dict:
+        try:
+            x = np.asarray(req["inputs"], np.float32)
+        except KeyError:
+            raise _ClientError("missing required field 'inputs'") from None
+        except (TypeError, ValueError) as e:
+            raise _ClientError(f"bad 'inputs': {e}") from None
+        if req.get("single", False):
+            x = x[None, ...]   # one unbatched example
+        top = int(req.get("decode_top", 0))
+        if top > 0 and self.labels is None:
+            raise _ClientError(
+                "server started without labels; decode_top unavailable")
+        out = np.asarray(self.pi.output(x))
+        with self._served_lock:
+            self._served += x.shape[0]
+        resp = {"outputs": out.tolist()}
+        if top > 0:
+            resp["decoded"] = [
+                [{"class": c, "wnid": w, "label": l, "probability": p}
+                 for (c, w, l, p) in row]
+                for row in self.labels.decode_predictions(out, top=top)]
+        return resp
+
+    def _status_facts(self) -> dict:
+        return {
+            "model": type(self.pi.net).__name__,
+            "inference_mode": self.pi.mode,
+            "batch_limit": self.pi.batch_limit,
+            "served": self._served,
+            "queue_depth": self.pi.queue_depth(),
+            "healthy": self.pi.healthy,
+            "ready": self._ready and self.pi.healthy,
+            "has_labels": self.labels is not None}
+
+    # --------------------------------------------------------------- start
     def start(self) -> "ModelServer":
         import http.server
         import socketserver
@@ -62,53 +126,68 @@ class ModelServer:
         server = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
-            def _send(self, code, obj):
+            def _send(self, code, obj, headers=()):
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in headers:
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _send_error(self, code, exc, headers=()):
+                self._send(code, {"error": str(exc),
+                                  "error_class": type(exc).__name__},
+                           headers)
+
             def do_GET(self):
-                if self.path.rstrip("/") == "/status":
-                    self._send(200, {
-                        "model": type(server.pi.net).__name__,
-                        "inference_mode": server.pi.mode,
-                        "batch_limit": server.pi.batch_limit,
-                        "served": server._served,
-                        "has_labels": server.labels is not None})
+                path = self.path.rstrip("/")
+                if path == "/status":
+                    self._send(200, server._status_facts())
+                elif path == "/healthz":
+                    if server.pi.healthy:
+                        self._send(200, {"status": "ok"})
+                    else:
+                        self._send(503, {"status": "unhealthy",
+                                         "healthy": False},
+                                   [("Retry-After", "1")])
+                elif path == "/readyz":
+                    if server._ready and server.pi.healthy:
+                        self._send(200, {"status": "ready"})
+                    else:
+                        self._send(503, {"status": "not ready"},
+                                   [("Retry-After", "1")])
                 else:
-                    self._send(404, {"error": f"no route {self.path}"})
+                    self._send(404, {"error": f"no route {self.path}",
+                                     "error_class": "NotFound"})
 
             def do_POST(self):
+                path = self.path.rstrip("/")
+                if path != "/predict":
+                    self._send(404, {"error": f"no route {self.path}",
+                                     "error_class": "NotFound"})
+                    return
                 try:
-                    if self.path.rstrip("/") != "/predict":
-                        raise ValueError(f"no route {self.path}")
+                    _fire("serve.request")
                     n = int(self.headers.get("Content-Length", 0))
-                    req = json.loads(self.rfile.read(n).decode())
-                    x = np.asarray(req["inputs"], np.float32)
-                    if req.get("single", False):
-                        x = x[None, ...]   # one unbatched example
-                    out = np.asarray(server.pi.output(x))
-                    with server._served_lock:
-                        server._served += x.shape[0]
-                    resp = {"outputs": out.tolist()}
-                    top = int(req.get("decode_top", 0))
-                    if top > 0:
-                        if server.labels is None:
-                            raise ValueError(
-                                "server started without labels; "
-                                "decode_top unavailable")
-                        resp["decoded"] = [
-                            [{"class": c, "wnid": w, "label": l,
-                              "probability": p}
-                             for (c, w, l, p) in row]
-                            for row in server.labels.decode_predictions(
-                                out, top=top)]
-                    self._send(200, resp)
+                    try:
+                        req = json.loads(self.rfile.read(n).decode())
+                    except ValueError as e:
+                        raise _ClientError(f"malformed JSON body: {e}") \
+                            from None
+                    if not isinstance(req, dict):
+                        raise _ClientError("body must be a JSON object")
+                    self._send(200, server._handle_predict(req))
+                except _ClientError as e:
+                    self._send_error(400, e)
+                except _UNAVAILABLE as e:
+                    retry_after = getattr(e, "retry_after_s", 1.0) or 1.0
+                    self._send_error(
+                        503, e,
+                        [("Retry-After", f"{max(1, int(retry_after))}")])
                 except Exception as e:   # noqa: BLE001 - HTTP boundary
-                    self._send(400, {"error": str(e)})
+                    self._send_error(500, e)
 
             def log_message(self, *a):
                 pass
@@ -122,9 +201,11 @@ class ModelServer:
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
+        self._ready = True
         return self
 
     def stop(self):
+        self._ready = False   # flip /readyz before tearing anything down
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -134,30 +215,101 @@ class ModelServer:
 
 
 class ModelClient:
-    """Minimal client for ModelServer (the serve-route consumer)."""
+    """Client for ModelServer (the serve-route consumer).
 
-    def __init__(self, url: str, timeout: float = 30.0):
+    HTTP errors surface as typed ServingError carrying the status code
+    and the server's JSON {error, error_class} payload (no more
+    swallowed bodies). Idempotent calls (/predict, /status, probes)
+    retry on connection errors and 503 per `retry` — pass
+    `retry=Retry(max_attempts=1)` to disable."""
+
+    def __init__(self, url: str, timeout: float = 30.0,
+                 retry: Optional[Retry] = None):
         self.url = url.rstrip("/")
         self.timeout = timeout
+        self.retry = retry if retry is not None else Retry(
+            max_attempts=3, initial_backoff_s=0.05, max_backoff_s=1.0,
+            retryable=self._retryable)
 
-    def _post(self, route: str, payload: dict) -> dict:
+    @staticmethod
+    def _retryable(exc: Exception) -> bool:
+        if isinstance(exc, ServingError):
+            return exc.retryable
+        return isinstance(exc, (ConnectionError, OSError, TimeoutError))
+
+    def _request(self, route: str, payload: Optional[dict] = None) -> dict:
+        import urllib.error
         import urllib.request
 
-        req = urllib.request.Request(
-            self.url + route, data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(req, timeout=self.timeout) as r:
-            return json.loads(r.read().decode())
+        def _once():
+            data = (json.dumps(payload).encode()
+                    if payload is not None else None)
+            req = urllib.request.Request(
+                self.url + route, data=data,
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req,
+                                            timeout=self.timeout) as r:
+                    return json.loads(r.read().decode())
+            except urllib.error.HTTPError as e:
+                raise self._serving_error(e) from None
+
+        return self.retry.call(_once)
+
+    @staticmethod
+    def _serving_error(e) -> ServingError:
+        """Parse the server's JSON error payload out of an HTTPError."""
+        try:
+            body = json.loads(e.read().decode())
+        except Exception:   # noqa: BLE001 - body may be anything
+            body = {}
+        retry_after = e.headers.get("Retry-After") if e.headers else None
+        return ServingError(
+            status=e.code,
+            message=body.get("error", str(e)),
+            error_class=body.get("error_class", ""),
+            body=body,
+            retry_after_s=float(retry_after) if retry_after else None)
+
+    def _post(self, route: str, payload: dict) -> dict:
+        return self._request(route, payload)
 
     def predict(self, inputs, decode_top: int = 0) -> dict:
         payload = {"inputs": np.asarray(inputs).tolist()}
         if decode_top:
             payload["decode_top"] = decode_top
-        return self._post("/predict", payload)
+        return self._request("/predict", payload)
 
     def status(self) -> dict:
+        return self._request("/status")
+
+    def healthz(self) -> bool:
+        """True iff the server reports itself live (no retry — a probe
+        must see the instantaneous truth)."""
+        try:
+            self._probe("/healthz")
+            return True
+        except ServingError as e:
+            if e.status == 503:
+                return False
+            raise
+
+    def readyz(self) -> bool:
+        try:
+            self._probe("/readyz")
+            return True
+        except ServingError as e:
+            if e.status == 503:
+                return False
+            raise
+
+    def _probe(self, route: str) -> dict:
+        import urllib.error
         import urllib.request
 
-        with urllib.request.urlopen(self.url + "/status",
-                                    timeout=self.timeout) as r:
-            return json.loads(r.read().decode())
+        req = urllib.request.Request(self.url + route)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            raise self._serving_error(e) from None

@@ -34,6 +34,10 @@ from typing import Callable, Optional, Tuple
 
 import numpy as np
 
+from deeplearning4j_tpu.resilience import checkpoint_integrity as _ci
+from deeplearning4j_tpu.resilience.faults import fire as _fire
+from deeplearning4j_tpu.resilience.retry import Retry
+
 
 class TrainingMaster:
     """Orchestrates SPMD data-parallel training of one net across all
@@ -48,7 +52,9 @@ class TrainingMaster:
                  checkpoint_every: int = 0, mesh=None,
                  averaging_frequency: int = 1,
                  threshold_compression: float = 0.0,
-                 checkpoint_format: str = "npz"):
+                 checkpoint_format: str = "npz",
+                 keep_last: int = 0,
+                 checkpoint_retry: Optional[Retry] = None):
         """`averaging_frequency=k > 1` runs k-step local SGD between
         parameter rendezvous — each dp shard trains privately for k
         steps, then params (+ updater state) are averaged. This is the
@@ -82,6 +88,13 @@ class TrainingMaster:
         self.threshold_compression = float(threshold_compression)
         _require_local_sgd(self.averaging_frequency,
                            self.threshold_compression)
+        # keep_last > 0 prunes old step checkpoints after each save;
+        # transient filesystem errors on the checkpoint path retry with
+        # backoff (injected faults / corruption are NOT retryable)
+        self.keep_last = int(keep_last)
+        self._ckpt_retry = checkpoint_retry or Retry(
+            max_attempts=3, initial_backoff_s=0.05,
+            retryable=lambda e: isinstance(e, OSError))
         self._staged = False
         self._local_step = None
 
@@ -195,6 +208,7 @@ class TrainingMaster:
             == "truncated_bptt"
         with self.mesh:
             for step in range(start_step, num_steps):
+                _fire("train.step")
                 t0 = time.perf_counter()
                 x, y = self._global_batch(*batch_fn(step))
                 t1 = time.perf_counter()
@@ -253,6 +267,7 @@ class TrainingMaster:
         with self.mesh:
             step = start_step
             while step < num_steps:
+                _fire("train.step")
                 t0 = time.perf_counter()
                 group = [batch_fn(s)
                          for s in range(step, min(step + k, num_steps))]
@@ -410,9 +425,13 @@ class TrainingMaster:
         """Write {params, updater state, states, step, rng}.
 
         format="npz": process 0 gathers everything to host and writes
-        one atomic .npz (shared-FS model, ref
+        one crash-safe .npz (shared-FS model, ref
         ParameterAveragingTrainingMaster's driver-side ownership) —
-        right for replicated dp training at this scale.
+        right for replicated dp training at this scale. The write is
+        tmp + fsync + os.replace with a sha256 manifest entry recorded
+        from the pre-publish bytes, so a kill mid-write publishes
+        nothing and a torn write is detected on load; transient OSErrors
+        retry per `checkpoint_retry`; `keep_last` prunes old steps.
         format="orbax": every process participates in an
         orbax.checkpoint save (SURVEY §7's "orbax-style sharded
         checkpoints for scale" — sharded arrays are written without
@@ -432,16 +451,33 @@ class TrainingMaster:
             for i, leaf in enumerate(jax.tree_util.tree_leaves(tree)):
                 payload[f"{group}:{i}"] = self._host_leaf(leaf)
         payload["rng"] = np.asarray(net._rng)
-        tmp = self._ckpt_path(step) + ".tmp.npz"   # savez appends .npz
-        np.savez(tmp, **payload)
-        os.replace(tmp, self._ckpt_path(step))   # atomic publish
+        # self-describing: fallback loads recover position without
+        # trusting latest.json (which may point at the damaged step)
+        payload["step"] = np.asarray(step)
+        payload["iteration"] = np.asarray(int(net.iteration))
+        payload["epoch"] = np.asarray(int(net.epoch))
+        final = self._ckpt_path(step)
+        fn = os.path.basename(final)
+
+        def _write():
+            with _ci.atomic_writer(final, suffix=".tmp.npz") as tmp:
+                with open(tmp, "wb") as f:
+                    np.savez(f, **payload)
+                digest = _ci.sha256_file(tmp)
+                size = os.path.getsize(tmp)
+                # chaos hook: 'raise' = kill mid-write (tmp discarded,
+                # nothing published); 'truncate' = torn write slipping
+                # past the atomic publish — caught by the checksum
+                _fire("checkpoint.write", path=tmp)
+            _ci.record_checksum(self.checkpoint_dir, fn, digest, size,
+                                extra={"step": step})
+
+        self._ckpt_retry.call(_write)
         meta = {"step": step, "iteration": int(net.iteration),
                 "epoch": int(net.epoch)}
-        with open(os.path.join(self.checkpoint_dir, "latest.json.tmp"),
-                  "w") as f:
-            json.dump(meta, f)
-        os.replace(os.path.join(self.checkpoint_dir, "latest.json.tmp"),
-                   os.path.join(self.checkpoint_dir, "latest.json"))
+        _ci.atomic_write_json(
+            os.path.join(self.checkpoint_dir, "latest.json"), meta)
+        _ci.apply_retention(self.checkpoint_dir, self.keep_last)
 
     def _orbax_path(self, step: int) -> str:
         return os.path.abspath(os.path.join(
@@ -460,11 +496,8 @@ class TrainingMaster:
         if jax.process_index() == 0:
             meta = {"step": step, "iteration": int(net.iteration),
                     "epoch": int(net.epoch), "format": "orbax"}
-            tmp = os.path.join(self.checkpoint_dir, "latest.json.tmp")
-            with open(tmp, "w") as f:
-                json.dump(meta, f)
-            os.replace(tmp,
-                       os.path.join(self.checkpoint_dir, "latest.json"))
+            _ci.atomic_write_json(
+                os.path.join(self.checkpoint_dir, "latest.json"), meta)
 
     def _load_orbax(self, meta) -> int:
         import jax
@@ -484,20 +517,54 @@ class TrainingMaster:
         self._staged = True
         return meta["step"]
 
-    def load_latest_checkpoint(self) -> int:
-        """Restore the newest checkpoint if present; returns the step to
-        resume FROM (0 if none). All processes load the same file."""
-        if not self.checkpoint_dir:
-            return 0
+    @staticmethod
+    def _structural_ok(path: str) -> None:
+        """Cheap structural probe: a truncated/torn .npz fails to open
+        or to yield its zip directory. Raises on damage."""
+        with np.load(path) as z:
+            z["rng"]
+
+    def _read_latest_meta(self):
         latest = os.path.join(self.checkpoint_dir, "latest.json")
-        if not os.path.exists(latest):
+        try:
+            with open(latest) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            # missing or torn latest pointer: fall back to a dir scan
+            return None
+
+    def _select_valid_step(self, meta) -> Optional[int]:
+        """The step to restore: the latest pointer's target if it passes
+        checksum + structural validation, else the newest checkpoint in
+        the directory that does (SURVEY §5.3 made real: a truncated
+        'latest' must never win)."""
+        if meta is not None and "step" in meta:
+            step = meta["step"]
+            fn = os.path.basename(self._ckpt_path(step))
+            if _ci.validate_file(self.checkpoint_dir, fn):
+                try:
+                    self._structural_ok(self._ckpt_path(step))
+                    return step
+                except Exception:   # noqa: BLE001 - damaged file
+                    pass
+        return _ci.newest_valid_checkpoint(
+            self.checkpoint_dir, structural_check=self._structural_ok)
+
+    def load_latest_checkpoint(self) -> int:
+        """Restore the newest *valid* checkpoint if present; returns the
+        step to resume FROM (0 if none survives validation). All
+        processes load the same file. Corrupt/truncated candidates are
+        skipped in favor of the newest one passing sha256 + structural
+        checks."""
+        if not self.checkpoint_dir or not os.path.isdir(self.checkpoint_dir):
             return 0
-        with open(latest) as f:
-            meta = json.load(f)
-        if meta.get("format") == "orbax":
+        meta = self._read_latest_meta()
+        if meta is not None and meta.get("format") == "orbax":
             return self._load_orbax(meta)
-        step = meta["step"]
-        data = np.load(self._ckpt_path(step))
+        step = self._select_valid_step(meta)
+        if step is None:
+            return 0
+        data = self._ckpt_retry.call(np.load, self._ckpt_path(step))
         import jax
 
         net = self.net
@@ -514,8 +581,14 @@ class TrainingMaster:
             restore("upd", net.updater_states))
         net.states = self._replicated(restore("states", net.states))
         net._rng = jax.numpy.asarray(data["rng"])
-        net.iteration = meta["iteration"]
-        net.epoch = meta["epoch"]
+        # newer checkpoints are self-describing; latest.json only covers
+        # the pre-manifest format (and may describe a different step)
+        if "iteration" in data.files:
+            net.iteration = int(data["iteration"])
+            net.epoch = int(data["epoch"])
+        elif meta is not None and meta.get("step") == step:
+            net.iteration = meta["iteration"]
+            net.epoch = meta["epoch"]
         self._staged = True
         return step
 

@@ -1,0 +1,57 @@
+"""Resilience subsystem: fault injection, retry/circuit-breaking, and
+crash-safe checkpoint integrity.
+
+The SURVEY asserts fault tolerance (§5.3 "relaunch and resume from the
+latest checkpoint"); this package makes it *exercised*: a process-global
+seedable FaultInjector shared by tests and chaos runs, Retry/
+CircuitBreaker policies used on the checkpoint and serving paths, and
+atomic-write + sha256-manifest checkpoint integrity with
+newest-valid fallback. Serving-side graceful degradation (backpressure,
+deadlines, fail-fast shutdown, health probes) lives in
+parallel/inference.py and parallel/serving.py, built on the typed
+errors here.
+"""
+
+from deeplearning4j_tpu.resilience.errors import (
+    CheckpointIntegrityError,
+    CircuitOpenError,
+    DeadlineExceededError,
+    FaultInjectedError,
+    InferenceUnavailableError,
+    OverloadedError,
+    ResilienceError,
+    RetriesExhaustedError,
+    ServingError,
+    ShutdownError,
+)
+from deeplearning4j_tpu.resilience.faults import (
+    ENV_VAR as FAULTS_ENV_VAR,
+    FaultInjector,
+    FaultSpec,
+    fire,
+    injector,
+)
+from deeplearning4j_tpu.resilience.retry import CircuitBreaker, Retry
+from deeplearning4j_tpu.resilience.checkpoint_integrity import (
+    apply_retention,
+    atomic_write_bytes,
+    atomic_write_json,
+    atomic_writer,
+    newest_valid_checkpoint,
+    record_checksum,
+    require_valid,
+    sha256_file,
+    validate_file,
+)
+
+__all__ = [
+    "CheckpointIntegrityError", "CircuitOpenError",
+    "DeadlineExceededError", "FaultInjectedError",
+    "InferenceUnavailableError", "OverloadedError", "ResilienceError",
+    "RetriesExhaustedError", "ServingError", "ShutdownError",
+    "FAULTS_ENV_VAR", "FaultInjector", "FaultSpec", "fire", "injector",
+    "CircuitBreaker", "Retry",
+    "apply_retention", "atomic_write_bytes", "atomic_write_json",
+    "atomic_writer", "newest_valid_checkpoint", "record_checksum",
+    "require_valid", "sha256_file", "validate_file",
+]

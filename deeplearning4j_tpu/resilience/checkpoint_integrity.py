@@ -1,0 +1,179 @@
+"""Crash-safe file writes + checkpoint checksum manifests.
+
+The SURVEY's recovery story ("relaunch with the same arguments, resume
+from the latest checkpoint") only holds if (a) a kill mid-write can
+never publish a partial file and (b) a torn write that slips through
+anyway is *detected* and skipped in favor of the newest valid
+checkpoint. This module provides both halves:
+
+  atomic_writer(path)       tmp file in the same directory -> flush ->
+                            fsync -> os.replace (atomic on POSIX)
+  MANIFEST (manifest.json)  per-directory {filename: {sha256, size}},
+                            itself written atomically; the hash is taken
+                            from the tmp file BEFORE the fault-injection
+                            point, so a torn write shows up as a
+                            mismatch on load
+  newest_valid_checkpoint   scan fallback when the latest pointer or
+                            file is damaged
+  apply_retention           keep_last pruning of step files + manifest
+
+Orbax-format checkpoints keep their own internal integrity story;
+manifest parity for them is an open item (ROADMAP).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import os
+import re
+from typing import Dict, Iterator, List, Optional
+
+from deeplearning4j_tpu.resilience.errors import CheckpointIntegrityError
+
+MANIFEST = "manifest.json"
+_STEP_RE = re.compile(r"step-(\d+)\.npz$")
+
+
+def sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(chunk), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+@contextlib.contextmanager
+def atomic_writer(path: str, suffix: str = ".tmp") -> Iterator[str]:
+    """Yield a tmp path next to `path`; publish atomically on success.
+
+    On exception the tmp file is removed and nothing is published — the
+    previous version of `path` (if any) survives a crash mid-write."""
+    path = os.fspath(path)
+    tmp = path + suffix
+    try:
+        yield tmp
+        with open(tmp, "rb+") as f:
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.remove(tmp)
+        raise
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    with atomic_writer(path) as tmp:
+        with open(tmp, "wb") as f:
+            f.write(data)
+
+
+def atomic_write_json(path: str, obj) -> None:
+    atomic_write_bytes(path, json.dumps(obj).encode())
+
+
+# ----------------------------------------------------------------- manifest
+def _manifest_path(directory: str) -> str:
+    return os.path.join(directory, MANIFEST)
+
+
+def read_manifest(directory: str) -> Dict[str, dict]:
+    p = _manifest_path(directory)
+    if not os.path.exists(p):
+        return {}
+    try:
+        with open(p) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        # a damaged manifest must not take down recovery — files can
+        # still be structurally validated one by one
+        return {}
+
+
+def record_checksum(directory: str, filename: str, sha256: str,
+                    size: int, extra: Optional[dict] = None) -> None:
+    """Merge one entry into the directory manifest (atomic rewrite)."""
+    manifest = read_manifest(directory)
+    manifest[filename] = {"sha256": sha256, "size": int(size),
+                          **(extra or {})}
+    atomic_write_json(_manifest_path(directory), manifest)
+
+
+def forget_checksum(directory: str, filename: str) -> None:
+    manifest = read_manifest(directory)
+    if filename in manifest:
+        del manifest[filename]
+        atomic_write_json(_manifest_path(directory), manifest)
+
+
+def validate_file(directory: str, filename: str) -> bool:
+    """True iff `filename` matches its manifest entry (size + sha256).
+
+    Files with no manifest entry (pre-manifest checkpoints) pass on
+    existence alone — structural validation is the caller's fallback."""
+    path = os.path.join(directory, filename)
+    if not os.path.exists(path):
+        return False
+    entry = read_manifest(directory).get(filename)
+    if entry is None:
+        return True
+    try:
+        if os.path.getsize(path) != entry["size"]:
+            return False
+        return sha256_file(path) == entry["sha256"]
+    except OSError:
+        return False
+
+
+def require_valid(directory: str, filename: str) -> None:
+    if not validate_file(directory, filename):
+        raise CheckpointIntegrityError(
+            f"{filename} in {directory} failed checksum validation "
+            "(truncated or torn write?)")
+
+
+# ----------------------------------------------------------------- recovery
+def list_step_checkpoints(directory: str) -> List[int]:
+    if not directory or not os.path.isdir(directory):
+        return []
+    steps = []
+    for fn in os.listdir(directory):
+        m = _STEP_RE.match(fn)
+        if m:
+            steps.append(int(m.group(1)))
+    return sorted(steps)
+
+
+def newest_valid_checkpoint(directory: str,
+                            structural_check=None) -> Optional[int]:
+    """Newest step whose file passes checksum (and, when the manifest
+    has no entry, `structural_check(path)`) — None if nothing valid."""
+    for step in reversed(list_step_checkpoints(directory)):
+        fn = f"step-{step:08d}.npz"
+        if not validate_file(directory, fn):
+            continue
+        if (structural_check is not None
+                and read_manifest(directory).get(fn) is None):
+            try:
+                structural_check(os.path.join(directory, fn))
+            except Exception:   # noqa: BLE001 - any load failure = invalid
+                continue
+        return step
+    return None
+
+
+def apply_retention(directory: str, keep_last: int) -> List[int]:
+    """Prune step checkpoints beyond the newest `keep_last`; returns the
+    pruned steps. keep_last <= 0 keeps everything."""
+    if keep_last <= 0:
+        return []
+    steps = list_step_checkpoints(directory)
+    pruned = steps[:-keep_last] if len(steps) > keep_last else []
+    for step in pruned:
+        fn = f"step-{step:08d}.npz"
+        with contextlib.suppress(OSError):
+            os.remove(os.path.join(directory, fn))
+        forget_checksum(directory, fn)
+    return pruned

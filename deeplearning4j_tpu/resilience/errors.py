@@ -1,0 +1,90 @@
+"""Typed failure vocabulary for the resilience subsystem.
+
+One shared hierarchy so every layer (checkpoint I/O, batched inference,
+HTTP serving) can signal *which* failure happened instead of collapsing
+everything into a bare Exception / HTTP 400 — callers route on type:
+retry (transient), shed load (Overloaded), fail over (integrity), or
+surface (fatal).
+"""
+
+from __future__ import annotations
+
+
+class ResilienceError(RuntimeError):
+    """Base for every typed failure raised by this subsystem."""
+
+
+class FaultInjectedError(ResilienceError):
+    """Raised by FaultInjector 'raise' faults (a simulated crash)."""
+
+    def __init__(self, point: str, hit: int):
+        super().__init__(f"injected fault at {point!r} (hit #{hit})")
+        self.point = point
+        self.hit = hit
+
+
+class ShutdownError(ResilienceError):
+    """The component was shut down; queued/pending work was cancelled."""
+
+
+class OverloadedError(ResilienceError):
+    """Bounded queue is full — backpressure instead of unbounded latency.
+
+    `retry_after_s` is advisory (surfaced as HTTP Retry-After)."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceededError(ResilienceError):
+    """An operation did not finish within its deadline."""
+
+
+class InferenceUnavailableError(ResilienceError):
+    """The batcher thread died; this front-end can no longer serve."""
+
+
+class CircuitOpenError(ResilienceError):
+    """CircuitBreaker is open — calls are rejected without attempting."""
+
+    def __init__(self, msg: str, retry_after_s: float = 0.0):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+class RetriesExhaustedError(ResilienceError):
+    """Retry gave up; `cause` is the last underlying exception."""
+
+    def __init__(self, msg: str, cause: Exception, attempts: int):
+        super().__init__(msg)
+        self.cause = cause
+        self.attempts = attempts
+
+
+class CheckpointIntegrityError(ResilienceError):
+    """A checkpoint/model file failed checksum or structural validation."""
+
+
+class ServingError(ResilienceError):
+    """HTTP error surfaced by ModelClient with the server's own story.
+
+    Carries the status code plus the parsed JSON error payload
+    (`error`, `error_class`) the server returned, so callers see e.g.
+    status=503 error_class='OverloadedError' instead of a swallowed
+    urllib HTTPError."""
+
+    def __init__(self, status: int, message: str,
+                 error_class: str = "", body: dict | None = None,
+                 retry_after_s: float | None = None):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+        self.message = message
+        self.error_class = error_class
+        self.body = body or {}
+        self.retry_after_s = retry_after_s
+
+    @property
+    def retryable(self) -> bool:
+        """503 (and 429) mean 'try again later'; 4xx/500 do not."""
+        return self.status in (429, 503)

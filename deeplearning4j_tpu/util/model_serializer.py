@@ -14,11 +14,19 @@ from __future__ import annotations
 
 import io
 import json
+import os
 import zipfile
 from typing import Any, Optional
 
 import jax
 import numpy as np
+
+from deeplearning4j_tpu.resilience.checkpoint_integrity import (
+    atomic_writer,
+    sha256_file,
+)
+from deeplearning4j_tpu.resilience.errors import CheckpointIntegrityError
+from deeplearning4j_tpu.resilience.faults import fire as _fire
 
 CONFIG_ENTRY = "configuration.json"
 COEFFICIENTS_ENTRY = "coefficients.npz"
@@ -39,9 +47,18 @@ def _tree_to_npz_bytes(tree) -> bytes:
 
 def _tree_from_npz_bytes(data: bytes, like):
     """Restore leaves into the structure of `like` (the freshly-init'd
-    net's pytree): structural match is validated by leaf count/shape."""
+    net's pytree): structural match is validated by leaf count/shape.
+
+    Leaves are materialized as XLA-owned device arrays (jnp.array with
+    copy=True), NOT raw numpy buffers: the train step donates its
+    params/updater/states inputs (donate_argnums), and on CPU jax can
+    zero-copy-alias a numpy buffer — donating host memory jax does not
+    exclusively own corrupts the restored state nondeterministically
+    (NaNs / divergent params after the first post-restore fit)."""
+    import jax.numpy as jnp
+
     with np.load(io.BytesIO(data)) as z:
-        leaves = [z[f"leaf_{i}"] for i in range(
+        leaves = [jnp.array(z[f"leaf_{i}"], copy=True) for i in range(
             sum(1 for k in z.files if k.startswith("leaf_")))]
     like_leaves, treedef = jax.tree_util.tree_flatten(like)
     if len(leaves) != len(like_leaves):
@@ -55,34 +72,77 @@ def _tree_from_npz_bytes(data: bytes, like):
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+def _checksum_path(path) -> str:
+    return os.fspath(path) + ".sha256"
+
+
 def write_model(net, path, save_updater: bool = True,
                 normalizer: Optional[Any] = None) -> None:
-    """Save a MultiLayerNetwork/ComputationGraph to a zip file."""
+    """Save a MultiLayerNetwork/ComputationGraph to a zip file.
+
+    Crash-safe: the zip is assembled in a tmp file and published with
+    fsync + os.replace (a kill mid-write never leaves a partial model at
+    `path`), and a `<path>.sha256` sidecar records the digest of the
+    pre-publish bytes so torn writes are detected on restore."""
     if net.params is None:
         raise ValueError("Network not initialized; nothing to save")
-    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
-        z.writestr(CONFIG_ENTRY, net.conf.to_json())
-        z.writestr(COEFFICIENTS_ENTRY, _tree_to_npz_bytes(net.params))
-        z.writestr(STATES_ENTRY, _tree_to_npz_bytes(net.states))
-        if save_updater and net.updater_states is not None:
-            z.writestr(UPDATER_ENTRY, _tree_to_npz_bytes(net.updater_states))
-        if normalizer is not None:
-            z.writestr(NORMALIZER_ENTRY, json.dumps(normalizer.to_dict()))
-        z.writestr(META_ENTRY, json.dumps({
-            "format": "deeplearning4j_tpu",
-            "version": 1,
-            "model_type": type(net).__name__,
-            "iteration": net.iteration,
-            "epoch": net.epoch,
-        }))
+    path = os.fspath(path)
+    with atomic_writer(path) as tmp:
+        with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as z:
+            z.writestr(CONFIG_ENTRY, net.conf.to_json())
+            z.writestr(COEFFICIENTS_ENTRY, _tree_to_npz_bytes(net.params))
+            z.writestr(STATES_ENTRY, _tree_to_npz_bytes(net.states))
+            if save_updater and net.updater_states is not None:
+                z.writestr(UPDATER_ENTRY,
+                           _tree_to_npz_bytes(net.updater_states))
+            if normalizer is not None:
+                z.writestr(NORMALIZER_ENTRY,
+                           json.dumps(normalizer.to_dict()))
+            z.writestr(META_ENTRY, json.dumps({
+                "format": "deeplearning4j_tpu",
+                "version": 1,
+                "model_type": type(net).__name__,
+                "iteration": net.iteration,
+                "epoch": net.epoch,
+            }))
+        digest = sha256_file(tmp)
+        # chaos hook: 'raise' = kill mid-write, 'truncate' = torn write
+        _fire("checkpoint.write", path=tmp)
+        with open(_checksum_path(path) + ".tmp", "w") as f:
+            f.write(digest)
+        os.replace(_checksum_path(path) + ".tmp", _checksum_path(path))
+
+
+def verify_model(path) -> bool:
+    """True iff `path` matches its .sha256 sidecar (files written before
+    the sidecar existed pass on existence alone)."""
+    path = os.fspath(path)
+    if not os.path.exists(path):
+        return False
+    cp = _checksum_path(path)
+    if not os.path.exists(cp):
+        return True
+    try:
+        with open(cp) as f:
+            return sha256_file(path) == f.read().strip()
+    except OSError:
+        return False
+
+
+def _require_valid(path) -> None:
+    if not verify_model(path):
+        raise CheckpointIntegrityError(
+            f"{path} failed sha256 validation (truncated or torn write?)")
 
 
 def restore_multi_layer_network(path, load_updater: bool = True):
     """Load a MultiLayerNetwork from a zip written by write_model
-    (ref: ModelSerializer.restoreMultiLayerNetwork:137)."""
+    (ref: ModelSerializer.restoreMultiLayerNetwork:137). Raises
+    CheckpointIntegrityError if the file fails its sha256 sidecar."""
     from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
+    _require_valid(path)
     with zipfile.ZipFile(path, "r") as z:
         conf = MultiLayerConfiguration.from_json(
             z.read(CONFIG_ENTRY).decode())
@@ -104,12 +164,14 @@ def restore_multi_layer_network(path, load_updater: bool = True):
 
 
 def restore_computation_graph(path, load_updater: bool = True):
-    """Load a ComputationGraph from a zip written by write_model."""
+    """Load a ComputationGraph from a zip written by write_model.
+    Raises CheckpointIntegrityError on sha256 sidecar mismatch."""
     from deeplearning4j_tpu.nn.conf.graph_conf import (
         ComputationGraphConfiguration,
     )
     from deeplearning4j_tpu.nn.graph import ComputationGraph
 
+    _require_valid(path)
     with zipfile.ZipFile(path, "r") as z:
         conf = ComputationGraphConfiguration.from_json(
             z.read(CONFIG_ENTRY).decode())
@@ -143,6 +205,7 @@ class ModelSerializer:
 
     writeModel = staticmethod(write_model)
     write_model = staticmethod(write_model)
+    verify_model = staticmethod(verify_model)
     restoreMultiLayerNetwork = staticmethod(restore_multi_layer_network)
     restore_multi_layer_network = staticmethod(restore_multi_layer_network)
     restoreComputationGraph = staticmethod(restore_computation_graph)

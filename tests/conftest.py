@@ -34,3 +34,45 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 @pytest.fixture
 def rng():
     return np.random.default_rng(12345)
+
+
+# ------------------------------------------------------------ hang guards
+# pytest.ini's faulthandler_timeout dumps tracebacks on a stuck test but
+# does not end it; this watchdog turns the hang into a TimeoutError so
+# one bad test fails instead of eating the tier-1 time budget. SIGALRM
+# interrupts even a bare `threading.Event().wait()` on the main thread.
+_PER_TEST_TIMEOUT_S = 300
+
+
+@pytest.fixture(autouse=True)
+def _hang_guard(request):
+    import signal
+    import threading
+
+    if (not hasattr(signal, "SIGALRM")
+            or threading.current_thread() is not threading.main_thread()):
+        yield
+        return
+
+    def _on_timeout(signum, frame):
+        raise TimeoutError(
+            f"test exceeded {_PER_TEST_TIMEOUT_S}s hang guard "
+            f"({request.node.nodeid})")
+
+    old = signal.signal(signal.SIGALRM, _on_timeout)
+    signal.setitimer(signal.ITIMER_REAL, _PER_TEST_TIMEOUT_S)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, old)
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    """Chaos isolation: no armed fault may leak into the next test."""
+    from deeplearning4j_tpu.resilience.faults import injector
+
+    injector().clear()
+    yield
+    injector().clear()

@@ -136,16 +136,22 @@ def test_model_server_error_paths(class_index):
     import urllib.request
 
     from deeplearning4j_tpu.parallel.serving import ModelClient, ModelServer
+    from deeplearning4j_tpu.resilience import ServingError
 
     server = ModelServer(_net()).start()   # no labels
     try:
         client = ModelClient(f"http://127.0.0.1:{server.port}")
-        with pytest.raises(urllib.error.HTTPError):
+        # typed error with the server's own story (no swallowed bodies)
+        with pytest.raises(ServingError) as ei:
             client.predict(np.zeros((1, 8), np.float32), decode_top=3)
+        assert ei.value.status == 400
+        assert "labels" in ei.value.message
+        # unknown routes are 404 (was a blanket 400)
         req = urllib.request.Request(
             f"http://127.0.0.1:{server.port}/nope", data=b"{}",
             headers={"Content-Type": "application/json"})
-        with pytest.raises(urllib.error.HTTPError):
+        with pytest.raises(urllib.error.HTTPError) as hei:
             urllib.request.urlopen(req, timeout=5)
+        assert hei.value.code == 404
     finally:
         server.stop()
