@@ -24,6 +24,7 @@ from deeplearning4j_tpu.nn.conf.graph_conf import (
     GraphNode,
 )
 from deeplearning4j_tpu.nn.conf.graph_vertices import LastTimeStepVertex
+from deeplearning4j_tpu.nn.jit_cache import JitCache
 from deeplearning4j_tpu.nn.layers.core import BaseOutputLayer
 from deeplearning4j_tpu.nn.layers.recurrent import (
     LSTM,
@@ -83,7 +84,7 @@ class ComputationGraph:
         self._score = None
         self.listeners: List = []
         self._rng = None
-        self._jit_cache: Dict[str, Any] = {}
+        self._jit_cache: JitCache = JitCache()
         self._updaters: Optional[Dict[str, Any]] = None
         self._lr_score_factor = 1.0   # lr_policy="score" decay state
         self._best_score = None
@@ -349,6 +350,8 @@ class ComputationGraph:
 
         def step_fn(params, upd_states, states, step, inputs, labels,
                     fmasks, lmasks, rng, carries, lr_scale):
+            self._jit_cache.record_trace(
+                "train_c" if with_carries else "train")
             (loss, (new_states, new_carries)), grads = jax.value_and_grad(
                 loss_for_grad, has_aux=True)(
                     params, states, inputs, labels, rng, fmasks, lmasks,
@@ -394,6 +397,8 @@ class ComputationGraph:
 
         def step_fn(flat, uflat, states, step, inputs, labels,
                     fmasks, lmasks, rng, carries, lr_scale):
+            self._jit_cache.record_trace(
+                "train_flat_c" if with_carries else "train_flat")
             (loss, (new_states, new_carries)), g = jax.value_and_grad(
                 loss_for_grad, has_aux=True)(
                     flat, states, inputs, labels, rng, fmasks, lmasks,
@@ -574,6 +579,7 @@ class ComputationGraph:
             cd = self.compute_dtype
 
             def predict_fn(params, states, inputs):
+                self._jit_cache.record_trace("predict")
                 if cd is not None:
                     from deeplearning4j_tpu.nn.dtype import cast_floating
                     params = cast_floating(params, cd)

@@ -1,0 +1,54 @@
+"""JitCache: a jit-program cache that counts traces.
+
+XLA compiles one program per (function, input signature); an unexpected
+shape reaching a cached `jax.jit` function silently triggers a retrace
+plus a full recompile — the compile-once concern the TPU-compilation
+literature identifies as make-or-break for serving latency. The cache
+itself is still a plain dict of jitted callables; the addition is a
+thread-safe trace counter incremented from *inside* each traced
+function body (a Python side effect in a traced function runs exactly
+once per trace), so "did this load cause a recompile?" becomes an
+asserted property instead of a profiling session:
+
+    cache = JitCache()
+    def f(x):
+        cache.record_trace("predict")
+        return x * 2
+    cache["predict"] = jax.jit(f)
+
+`trace_counts()` snapshots {key: traces}; serving surfaces it on
+/status and the warmup regression test pins it to zero new traces
+under a mixed-size load.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class JitCache(dict):
+    """Dict of jitted programs + per-key trace counters.
+
+    Counters survive `clear()` of the program dict deliberately: a
+    cleared cache that re-traces is exactly the recompile event the
+    counters exist to expose."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._trace_lock = threading.Lock()
+        self._trace_counts: Dict[str, int] = {}
+
+    def record_trace(self, key: str) -> None:
+        """Call from inside a to-be-jitted function body: runs once per
+        trace (= once per compiled specialization), never at runtime."""
+        with self._trace_lock:
+            self._trace_counts[key] = self._trace_counts.get(key, 0) + 1
+
+    def trace_counts(self) -> Dict[str, int]:
+        with self._trace_lock:
+            return dict(self._trace_counts)
+
+    def total_traces(self) -> int:
+        with self._trace_lock:
+            return sum(self._trace_counts.values())

@@ -31,6 +31,7 @@ from deeplearning4j_tpu.nn.conf.network import (
     BackpropType,
     MultiLayerConfiguration,
 )
+from deeplearning4j_tpu.nn.jit_cache import JitCache
 from deeplearning4j_tpu.nn.layers.base import Layer
 from deeplearning4j_tpu.nn.layers.core import BaseOutputLayer
 from deeplearning4j_tpu.nn.layers.recurrent import LSTM, GravesBidirectionalLSTM
@@ -78,7 +79,7 @@ class MultiLayerNetwork:
         self._score = None
         self.listeners: List = []
         self._rng = None
-        self._jit_cache: Dict[str, Any] = {}
+        self._jit_cache: JitCache = JitCache()
         self._updaters = None
         self._lr_score_factor = 1.0   # lr_policy="score" decay state
         self._best_score = None
@@ -281,6 +282,8 @@ class MultiLayerNetwork:
 
         def step_fn(params, upd_states, states, step, x, y, fmask, lmask,
                     rng, carries, lr_scale):
+            self._jit_cache.record_trace(
+                "train_c" if with_carries else "train")
             (loss, (new_states, new_carries)), grads = jax.value_and_grad(
                 loss_for_grad, has_aux=True)(
                     params, states, x, y, rng, fmask, lmask,
@@ -443,6 +446,7 @@ class MultiLayerNetwork:
             cd = self.compute_dtype
 
             def predict_fn(params, states, x):
+                self._jit_cache.record_trace("predict")
                 if cd is not None:
                     from deeplearning4j_tpu.nn.dtype import cast_floating
                     params = cast_floating(params, cd)

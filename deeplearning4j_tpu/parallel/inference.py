@@ -9,15 +9,39 @@ model replicas. On TPU one compiled program already uses every chip in
 the mesh, so SEQUENTIAL degenerates to direct calls; the valuable part is
 BATCHED mode — coalescing concurrent small requests into one padded
 batch so the MXU runs full tiles. Batch sizes are bucketed to powers of
-two to bound XLA recompilation.
+two (hard-capped at next_pow2(batch_limit)) to bound XLA recompilation.
+
+Pipelined data plane (perf): the batcher is a two-stage pipeline.
+The ASSEMBLER stage coalesces requests directly into a preallocated
+padded bucket buffer (one copy, no intermediate np.concatenate),
+dispatches `net.output` and hands the *in-flight device value* to the
+COMPLETION stage without blocking on the host fetch — JAX dispatch is
+async, so batch N+1 assembles and dispatches while batch N computes.
+The completion stage performs the host fetch (the 4-6 ms per-dispatch
+RTT measured in PERF.md), slices rows back to their callers, and
+returns the staging buffer to the pool. The in-flight window is bounded
+(`pipeline_depth`), so backpressure still cascades: window full ->
+assembler stalls -> request queue fills -> `output()` sheds load.
+`pipeline_depth=0` degrades to the serialized dispatch-then-fetch loop
+(the bench_serving.py comparison baseline).
+
+Compile-once guards: `warmup=True` pre-traces `net.output` for every
+power-of-two bucket up to the cap at construction (shape derived from
+the net's configured InputType), and `stats()` surfaces the net's
+JitCache trace counters so "zero new traces under mixed-size load" is
+an asserted regression property. `adaptive_wait` shrinks the batching
+wait when the queue is deep (a full batch is already waiting — waiting
+adds latency, not throughput) and grows it back while idle.
 
 Graceful degradation (resilience subsystem): the request queue is
 bounded and `output()` sheds load with OverloadedError instead of
-blocking when it fills; every wait carries a deadline so a dead batcher
-thread surfaces as InferenceUnavailableError rather than a hang;
-`shutdown()` fails fast — queued and pending requests are signaled with
-ShutdownError, and the front-end reports itself unhealthy via
-`healthy` (the /healthz source of truth in serving.py).
+blocking when it fills; every wait carries a deadline so a dead
+pipeline thread surfaces as InferenceUnavailableError rather than a
+hang; `shutdown()` fails fast — queued, in-flight, and carried requests
+are signaled with ShutdownError, and the front-end reports itself
+unhealthy via `healthy` (the /healthz source of truth in serving.py).
+Death of EITHER pipeline stage (fault points `inference.batch` and
+`inference.complete`) drains every waiter.
 """
 
 from __future__ import annotations
@@ -25,7 +49,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -45,16 +69,47 @@ class InferenceMode:
 
 
 class _Pending:
-    __slots__ = ("x", "event", "result")
+    """One caller's request. Large requests may be split across several
+    dispatched batches (bucket-cap overshoot guard); `deliver` collects
+    row ranges and resolves once every row has arrived. deliver() is
+    only ever called from the single completion stage, so it needs no
+    lock of its own."""
+
+    __slots__ = ("x", "event", "result", "_left", "_out")
 
     def __init__(self, x):
         self.x = x
         self.event = threading.Event()
         self.result = None
+        self._left = x.shape[0]
+        self._out = None
 
     def resolve(self, result):
-        self.result = result
-        self.event.set()
+        if not self.event.is_set():
+            self.result = result
+            self.event.set()
+
+    def deliver(self, start: int, rows: np.ndarray) -> bool:
+        """Returns True when this delivery completed the request."""
+        if self.event.is_set():
+            return False
+        n = self.x.shape[0]
+        if self._out is None and start == 0 and rows.shape[0] == n:
+            self.resolve(rows)   # whole request in one batch (common)
+            return True
+        if self._out is None:
+            self._out = np.empty((n,) + rows.shape[1:], rows.dtype)
+        self._out[start:start + rows.shape[0]] = rows
+        self._left -= rows.shape[0]
+        if self._left <= 0:
+            self.resolve(self._out)
+            return True
+        return False
+
+
+# slot = (pending, src_row_start, n_rows): one contiguous row range of a
+# request placed in the batch currently being assembled
+_Slot = Tuple[_Pending, int, int]
 
 
 class ParallelInference:
@@ -67,19 +122,51 @@ class ParallelInference:
     def __init__(self, net, inference_mode: str = InferenceMode.BATCHED,
                  batch_limit: int = 32, queue_limit: int = 64,
                  max_wait_ms: float = 2.0,
-                 default_timeout_s: float = 30.0):
+                 default_timeout_s: float = 30.0,
+                 pipeline_depth: int = 2,
+                 warmup: bool = True,
+                 adaptive_wait: bool = True,
+                 min_wait_ms: float = 0.0):
         self.net = net
         self.mode = inference_mode
         self.batch_limit = batch_limit
         self.max_wait_ms = max_wait_ms
+        self.min_wait_ms = min_wait_ms
+        self.adaptive_wait = adaptive_wait
         self.default_timeout_s = default_timeout_s
+        self.pipeline_depth = max(0, int(pipeline_depth))
+        self._cap = self._bucket(batch_limit)   # hard bucket-shape ceiling
         self._queue: "queue.Queue[_Pending]" = queue.Queue(maxsize=queue_limit)
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._shutdown = False
         self._failure: Optional[BaseException] = None
         self._worker: Optional[threading.Thread] = None
+        self._completer: Optional[threading.Thread] = None
+        self._inflight: Optional["queue.Queue"] = None
+        # dispatched-but-not-completed batches, INCLUDING the one the
+        # completion stage is currently fetching (queue size alone
+        # undercounts it, which would let the assembler over-dispatch
+        # undersized batches while the device is already saturated).
+        # _slot_free wakes the assembler the moment a batch completes,
+        # so the device never idles on a polling interval.
+        self._inflight_n = 0
+        self._slot_free = threading.Event()
+        self._carry: Optional[Tuple[_Pending, int]] = None
+        self._buf_pool: Dict[tuple, List[np.ndarray]] = {}
+        self._wait_ms = float(max_wait_ms)
+        self._warmed_buckets: List[int] = []
+        self._batches_dispatched = 0
+        self._requests_completed = 0
         if self.mode == InferenceMode.BATCHED:
+            if warmup:
+                self.warmup()
+            if self.pipeline_depth > 0:
+                self._inflight = queue.Queue()
+                self._completer = threading.Thread(
+                    target=self._completion_loop, daemon=True,
+                    name="ParallelInference-completer")
+                self._completer.start()
             self._worker = threading.Thread(
                 target=self._batch_loop, daemon=True,
                 name="ParallelInference-batcher")
@@ -88,31 +175,98 @@ class ParallelInference:
     # ------------------------------------------------------------------
     @property
     def healthy(self) -> bool:
-        """False once shut down or the batcher thread has died."""
+        """False once shut down or either pipeline thread has died."""
         if self._shutdown or self._failure is not None:
             return False
         if self.mode == InferenceMode.BATCHED:
-            return self._worker is not None and self._worker.is_alive()
+            if self._worker is None or not self._worker.is_alive():
+                return False
+            if (self._completer is not None
+                    and not self._completer.is_alive()):
+                return False
         return True
 
     def queue_depth(self) -> int:
         return self._queue.qsize()
 
+    def trace_stats(self) -> dict:
+        """The net's JitCache trace counters (empty for nets without
+        one) — the recompile-regression observable."""
+        cache = getattr(self.net, "_jit_cache", None)
+        if cache is None or not hasattr(cache, "trace_counts"):
+            return {}
+        return {"trace_counts": cache.trace_counts(),
+                "total_traces": cache.total_traces()}
+
+    def stats(self) -> dict:
+        """Pipeline + compile-guard facts (surfaced on /status)."""
+        out = {
+            "pipeline_depth": self.pipeline_depth,
+            "in_flight": self._inflight_n,
+            "queue_depth": self._queue.qsize(),
+            "batches_dispatched": self._batches_dispatched,
+            "requests_completed": self._requests_completed,
+            "bucket_cap": self._cap,
+            "warmed_buckets": list(self._warmed_buckets),
+            "current_wait_ms": round(self._wait_ms, 4),
+            "adaptive_wait": self.adaptive_wait,
+        }
+        out.update(self.trace_stats())
+        return out
+
+    # ------------------------------------------------------------ warmup
+    def _warmup_tail_shape(self) -> Optional[tuple]:
+        """Per-example input shape from the net's configured InputType
+        (None when underivable, e.g. stub nets / multi-input graphs)."""
+        conf = getattr(self.net, "conf", None)
+        input_type = getattr(conf, "input_type", None)
+        if input_type is None:
+            return None
+        try:
+            return tuple(input_type.batch_shape(1))[1:]
+        except Exception:   # noqa: BLE001 - underivable shape: skip
+            return None
+
+    def warmup(self) -> List[int]:
+        """Pre-trace `net.output` for every power-of-two bucket up to
+        the cap, so a mixed-size request load causes ZERO new traces
+        (each one a full XLA recompile on TPU). Returns the buckets
+        traced; no-op when the input shape is underivable."""
+        tail = self._warmup_tail_shape()
+        if tail is None:
+            return []
+        done = []
+        b = 1
+        while b <= self._cap:
+            x = np.zeros((b,) + tail, np.float32)
+            with self._lock:
+                np.asarray(self.net.output(x))   # block: compile now
+            done.append(b)
+            b <<= 1
+        self._warmed_buckets = done
+        return done
+
+    # ------------------------------------------------------------------
     def _check_available(self):
         if self._shutdown:
             raise ShutdownError("ParallelInference is shut down")
         if self._failure is not None:
             raise InferenceUnavailableError(
                 f"batcher thread died: {self._failure!r}")
-        if (self.mode == InferenceMode.BATCHED
-                and (self._worker is None or not self._worker.is_alive())):
+        if self.mode == InferenceMode.BATCHED and self._threads_dead():
             raise InferenceUnavailableError("batcher thread is not running")
+
+    def _threads_dead(self) -> bool:
+        if self._worker is None or not self._worker.is_alive():
+            return True
+        return (self._completer is not None
+                and not self._completer.is_alive())
 
     def output(self, x, timeout_s: Optional[float] = None) -> np.ndarray:
         """Run inference; raises OverloadedError when the bounded queue
         is full (shed load, don't queue unbounded latency) and
         DeadlineExceededError / InferenceUnavailableError instead of
-        hanging when the batcher stalls or dies."""
+        hanging when the pipeline stalls or dies."""
         x = np.asarray(x)
         if timeout_s is None:
             timeout_s = self.default_timeout_s
@@ -129,15 +283,14 @@ class ParallelInference:
                 f"inference queue full ({self._queue.maxsize} waiting); "
                 "retry later") from None
         deadline = time.monotonic() + timeout_s
-        # poll in slices: a batcher that dies *after* the put but before
-        # its own drain would otherwise strand this waiter
+        # poll in slices: a pipeline thread that dies *after* the put but
+        # before its own drain would otherwise strand this waiter
         while not p.event.wait(timeout=min(
                 0.05, max(0.0, deadline - time.monotonic()))):
             if p.event.is_set():
                 break
-            if self._failure is not None or self._shutdown or (
-                    self._worker is not None
-                    and not self._worker.is_alive()):
+            if (self._failure is not None or self._shutdown
+                    or self._threads_dead()):
                 self._drain(self._unavailable_error())
                 if not p.event.is_set():
                     p.resolve(self._unavailable_error())
@@ -156,17 +309,27 @@ class ParallelInference:
             f"batcher thread died: {self._failure!r}")
 
     def shutdown(self):
-        """Fail fast: stop the batcher, then signal every queued request
-        with ShutdownError so no caller is left hanging."""
+        """Fail fast: stop both pipeline stages, then signal every
+        queued / in-flight request with ShutdownError so no caller is
+        left hanging."""
         self._shutdown = True
         self._stop.set()
         if self._worker is not None:
             self._worker.join(timeout=2.0)
-        self._drain(ShutdownError(
-            "ParallelInference shut down with requests in flight"))
+        if self._completer is not None:
+            self._completer.join(timeout=2.0)
+        err = ShutdownError(
+            "ParallelInference shut down with requests in flight")
+        self._drain(err)
+        self._drain_inflight(err)
 
     def _drain(self, error: Exception):
-        """Signal everything still queued with `error`."""
+        """Signal everything still queued (and any carried split
+        request) with `error`."""
+        carry = self._carry
+        self._carry = None
+        if carry is not None and not carry[0].event.is_set():
+            carry[0].resolve(error)
         while True:
             try:
                 p = self._queue.get_nowait()
@@ -174,6 +337,19 @@ class ParallelInference:
                 return
             if not p.event.is_set():
                 p.resolve(error)
+
+    def _drain_inflight(self, error: Exception):
+        if self._inflight is None:
+            return
+        while True:
+            try:
+                _, slots, key, buf = self._inflight.get_nowait()
+            except queue.Empty:
+                return
+            self._inflight_n -= 1
+            for p, _, _ in slots:
+                p.resolve(error)
+            self._put_buffer(key, buf)
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -183,50 +359,156 @@ class ParallelInference:
             b <<= 1
         return b
 
+    # ------------------------------------------------------- bucket pool
+    def _get_buffer(self, key: tuple) -> np.ndarray:
+        pool = self._buf_pool.get(key)
+        if pool:
+            return pool.pop()
+        bucket, tail, dtype_str = key
+        return np.zeros((bucket,) + tail, np.dtype(dtype_str))
+
+    def _put_buffer(self, key: tuple, buf: np.ndarray):
+        # bounded: at most window+1 buffers alive per bucket shape
+        pool = self._buf_pool.setdefault(key, [])
+        if len(pool) <= self.pipeline_depth:
+            pool.append(buf)
+
+    # --------------------------------------------------- adaptive wait
+    def _current_wait_s(self) -> float:
+        if not self.adaptive_wait:
+            return self.max_wait_ms / 1000.0
+        if self._queue.qsize() >= self.batch_limit:
+            return 0.0   # a full batch is already waiting
+        return self._wait_ms / 1000.0
+
+    def _adapt_wait(self, rows: int):
+        if not self.adaptive_wait:
+            return
+        if rows >= self.batch_limit:
+            # deep queue: batches fill instantly — waiting only adds
+            # latency, so shrink toward min_wait_ms
+            self._wait_ms = max(self.min_wait_ms, self._wait_ms * 0.5)
+        elif self._queue.qsize() == 0:
+            # idle: grow back toward max_wait_ms so sparse traffic still
+            # coalesces into full tiles
+            self._wait_ms = min(self.max_wait_ms,
+                                self._wait_ms * 1.5 + 0.05)
+
+    # ------------------------------------------------------- assembler
+    def _collect(self) -> Tuple[List[_Slot], int]:
+        """Gather up to batch_limit rows: the carried remainder of a
+        split request first, then queued requests. A request that would
+        push past batch_limit is split — its overflow rows carry into
+        the NEXT batch, so no bucket ever exceeds the cap."""
+        slots: List[_Slot] = []
+        rows = 0
+        limit = self.batch_limit
+        if self._carry is not None:
+            p, src = self._carry
+            self._carry = None
+            take = min(p.x.shape[0] - src, limit)
+            slots.append((p, src, take))
+            rows += take
+            if src + take < p.x.shape[0]:
+                self._carry = (p, src + take)
+                return slots, rows
+        else:
+            try:
+                first = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                return slots, 0
+            take = min(first.x.shape[0], limit)
+            slots.append((first, 0, take))
+            rows += take
+            if take < first.x.shape[0]:
+                self._carry = (first, take)
+                return slots, rows
+        wait_s = self._current_wait_s()
+        t0 = time.monotonic()
+        while rows < limit:
+            # while the in-flight window is full the device is the
+            # bottleneck — dispatching a partial batch now would only
+            # shrink coalescing, so keep collecting until a slot frees
+            window_full = (self._inflight is not None
+                           and self._inflight_n >= self.pipeline_depth)
+            if window_full:
+                try:
+                    p = self._queue.get_nowait()
+                except queue.Empty:
+                    if self._stop.is_set() or self._failure is not None:
+                        break
+                    self._slot_free.clear()
+                    if self._inflight_n >= self.pipeline_depth:
+                        self._slot_free.wait(timeout=0.05)
+                    continue
+            else:
+                remaining = wait_s - (time.monotonic() - t0)
+                if remaining <= 0 and self._queue.empty():
+                    break
+                try:
+                    p = self._queue.get(timeout=max(0.0, remaining))
+                except queue.Empty:
+                    break
+            take = min(p.x.shape[0], limit - rows)
+            slots.append((p, 0, take))
+            rows += take
+            if take < p.x.shape[0]:
+                self._carry = (p, take)
+                break
+        return slots, rows
+
+    def _assemble(self, slots: List[_Slot], rows: int):
+        """Coalesce request rows directly into a pooled padded bucket
+        buffer — ONE copy, no intermediate concatenate allocations."""
+        x0 = slots[0][0].x
+        tail = x0.shape[1:]
+        dtype = np.result_type(*[p.x.dtype for p, _, _ in slots]) \
+            if len(slots) > 1 else x0.dtype
+        bucket = self._bucket(rows)
+        key = (bucket, tail, np.dtype(dtype).str)
+        buf = self._get_buffer(key)
+        ofs = 0
+        for p, src, n in slots:
+            buf[ofs:ofs + n] = p.x[src:src + n]
+            ofs += n
+        if bucket > rows:
+            buf[rows:bucket] = 0   # pooled buffers carry stale rows
+        return key, buf
+
     def _batch_loop(self):
         try:
-            while not self._stop.is_set():
-                # chaos hook: a 'raise' here kills the batcher thread —
+            while not self._stop.is_set() and self._failure is None:
+                # chaos hook: a 'raise' here kills the assembler thread —
                 # the graceful-degradation drill for the serving path
                 _fire("inference.batch")
-                try:
-                    first = self._queue.get(timeout=0.05)
-                except queue.Empty:
+                slots, rows = self._collect()
+                if not slots:
                     continue
-                pending: List[_Pending] = [first]
-                rows = first.x.shape[0]
-                deadline = self.max_wait_ms / 1000.0
-                t0 = time.monotonic()
-                while rows < self.batch_limit:
-                    remaining = deadline - (time.monotonic() - t0)
-                    if remaining <= 0:
-                        break
-                    try:
-                        p = self._queue.get(timeout=remaining)
-                    except queue.Empty:
-                        break
-                    pending.append(p)
-                    rows += p.x.shape[0]
                 try:
-                    big = np.concatenate([p.x for p in pending], axis=0)
-                    bucket = self._bucket(big.shape[0])
-                    if bucket > big.shape[0]:
-                        pad = np.zeros(
-                            (bucket - big.shape[0],) + big.shape[1:],
-                            big.dtype)
-                        big = np.concatenate([big, pad], axis=0)
-                    with self._lock:
-                        out = np.asarray(self.net.output(jnp.asarray(big)))
-                    ofs = 0
-                    for p in pending:
-                        n = p.x.shape[0]
-                        p.resolve(out[ofs:ofs + n])
-                        ofs += n
-                except Exception as e:  # per-batch: propagate to callers
-                    for p in pending:
+                    key, buf = self._assemble(slots, rows)
+                except Exception as e:   # per-batch: propagate to callers
+                    for p, _, _ in slots:
                         p.resolve(e)
+                    continue
+                try:
+                    with self._lock:
+                        # async dispatch: hand the in-flight device value
+                        # to the completion stage; do NOT block on the
+                        # host fetch here
+                        out = self.net.output(jnp.asarray(buf))
+                except Exception as e:   # per-batch: propagate to callers
+                    for p, _, _ in slots:
+                        p.resolve(e)
+                    self._put_buffer(key, buf)
+                    continue
+                self._batches_dispatched += 1
+                self._adapt_wait(rows)
+                if self._completer is None:
+                    self._complete_batch(out, slots, key, buf)
+                else:
+                    self._submit_inflight((out, slots, key, buf))
         except BaseException as e:   # noqa: BLE001 - loop-level death
-            # batcher death is a degradation event, not a hang: record
+            # assembler death is a degradation event, not a hang: record
             # it (flips `healthy` and /healthz), then fail every waiter
             self._failure = e
         finally:
@@ -234,4 +516,75 @@ class ParallelInference:
                 self._drain(self._unavailable_error())
             elif self._stop.is_set():
                 self._drain(ShutdownError(
+                    "ParallelInference shut down with requests in flight"))
+
+    def _submit_inflight(self, item):
+        """Bounded in-flight window: block until the completion stage
+        frees a slot (backpressure), never past stop/death."""
+        while True:
+            if self._stop.is_set() or self._failure is not None or (
+                    self._completer is not None
+                    and not self._completer.is_alive()):
+                _, slots, key, buf = item
+                err = self._unavailable_error() \
+                    if not self._stop.is_set() else ShutdownError(
+                        "ParallelInference shut down with requests "
+                        "in flight")
+                for p, _, _ in slots:
+                    p.resolve(err)
+                self._put_buffer(key, buf)
+                return
+            if self._inflight_n >= self.pipeline_depth:
+                self._slot_free.clear()
+                if self._inflight_n >= self.pipeline_depth:
+                    self._slot_free.wait(timeout=0.05)
+                continue
+            self._inflight_n += 1
+            self._inflight.put(item)
+            return
+
+    # ------------------------------------------------------- completion
+    def _complete_batch(self, out, slots: List[_Slot], key, buf):
+        try:
+            host = np.asarray(out)   # host fetch: blocks until computed
+        except Exception as e:   # per-batch: propagate to callers
+            for p, _, _ in slots:
+                p.resolve(e)
+            self._put_buffer(key, buf)
+            return
+        if np.may_share_memory(host, buf):
+            # jnp.asarray can zero-copy-alias the staging buffer on CPU
+            # and identity-ish models can echo it back: never hand
+            # callers views into a buffer the pool will overwrite
+            host = host.copy()
+        self._put_buffer(key, buf)   # compute done: buffer reusable
+        ofs = 0
+        for p, src, n in slots:
+            if p.deliver(src, host[ofs:ofs + n]):
+                self._requests_completed += 1
+            ofs += n
+
+    def _completion_loop(self):
+        try:
+            while not self._stop.is_set() and self._failure is None:
+                # chaos hook: completion-stage death must degrade as
+                # gracefully as assembler death
+                _fire("inference.complete")
+                try:
+                    item = self._inflight.get(timeout=0.05)
+                except queue.Empty:
+                    continue
+                try:
+                    self._complete_batch(*item)
+                finally:
+                    self._inflight_n -= 1
+                    self._slot_free.set()
+        except BaseException as e:   # noqa: BLE001 - loop-level death
+            self._failure = e
+        finally:
+            if self._failure is not None:
+                self._drain_inflight(self._unavailable_error())
+                self._drain(self._unavailable_error())
+            elif self._stop.is_set():
+                self._drain_inflight(ShutdownError(
                     "ParallelInference shut down with requests in flight"))

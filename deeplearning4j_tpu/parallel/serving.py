@@ -41,14 +41,16 @@ from deeplearning4j_tpu.parallel.inference import (
     ParallelInference,
 )
 from deeplearning4j_tpu.resilience.errors import (
+    CircuitOpenError,
     DeadlineExceededError,
     InferenceUnavailableError,
     OverloadedError,
+    RetriesExhaustedError,
     ServingError,
     ShutdownError,
 )
 from deeplearning4j_tpu.resilience.faults import fire as _fire
-from deeplearning4j_tpu.resilience.retry import Retry
+from deeplearning4j_tpu.resilience.retry import CircuitBreaker, Retry
 
 # errors that mean "back off and retry": surfaced as 503 + Retry-After
 _UNAVAILABLE = (OverloadedError, ShutdownError, InferenceUnavailableError,
@@ -68,11 +70,17 @@ class ModelServer:
     def __init__(self, net, port: int = 0, host: str = "127.0.0.1",
                  inference_mode: str = InferenceMode.BATCHED,
                  batch_limit: int = 32, labels=None,
-                 output_activation: bool = True):
+                 output_activation: bool = True,
+                 pipeline_depth: int = 2, warmup: bool = True,
+                 max_wait_ms: float = 2.0, adaptive_wait: bool = True):
         self._owns_pi = not isinstance(net, ParallelInference)
         self.pi = (net if not self._owns_pi
                    else ParallelInference(net, inference_mode,
-                                          batch_limit=batch_limit))
+                                          batch_limit=batch_limit,
+                                          pipeline_depth=pipeline_depth,
+                                          warmup=warmup,
+                                          max_wait_ms=max_wait_ms,
+                                          adaptive_wait=adaptive_wait))
         self.labels = labels
         self.host = host
         self.port = port
@@ -108,7 +116,7 @@ class ModelServer:
         return resp
 
     def _status_facts(self) -> dict:
-        return {
+        facts = {
             "model": type(self.pi.net).__name__,
             "inference_mode": self.pi.mode,
             "batch_limit": self.pi.batch_limit,
@@ -117,6 +125,13 @@ class ModelServer:
             "healthy": self.pi.healthy,
             "ready": self._ready and self.pi.healthy,
             "has_labels": self.labels is not None}
+        # pipelined data-plane + compile-once guard facts: bucket
+        # warmup, trace/recompile counters, adaptive-wait state
+        facts["pipeline"] = self.pi.stats()
+        trace = self.pi.trace_stats()
+        facts["trace_counts"] = trace.get("trace_counts", {})
+        facts["total_traces"] = trace.get("total_traces", 0)
+        return facts
 
     # --------------------------------------------------------------- start
     def start(self) -> "ModelServer":
@@ -214,6 +229,9 @@ class ModelServer:
             self.pi.shutdown()
 
 
+_DEFAULT_BREAKER = object()   # sentinel: "construct the default breaker"
+
+
 class ModelClient:
     """Client for ModelServer (the serve-route consumer).
 
@@ -221,21 +239,65 @@ class ModelClient:
     and the server's JSON {error, error_class} payload (no more
     swallowed bodies). Idempotent calls (/predict, /status, probes)
     retry on connection errors and 503 per `retry` — pass
-    `retry=Retry(max_attempts=1)` to disable."""
+    `retry=Retry(max_attempts=1)` to disable.
+
+    A CircuitBreaker guards every request BY DEFAULT: repeated
+    unavailability (503s, connection errors, retry exhaustion) opens
+    the circuit and subsequent calls fail fast with CircuitOpenError —
+    letting a drowning server breathe instead of hammering it — until
+    the cooldown lets one probe through (half-open). Any response from
+    the server, even a 4xx/500, proves liveness and closes the circuit.
+    Pass `breaker=None` to disable, or your own CircuitBreaker to tune
+    thresholds. Health probes (`healthz`/`readyz`) bypass the breaker:
+    a probe must see the instantaneous truth."""
 
     def __init__(self, url: str, timeout: float = 30.0,
-                 retry: Optional[Retry] = None):
+                 retry: Optional[Retry] = None,
+                 breaker=_DEFAULT_BREAKER):
         self.url = url.rstrip("/")
         self.timeout = timeout
         self.retry = retry if retry is not None else Retry(
             max_attempts=3, initial_backoff_s=0.05, max_backoff_s=1.0,
             retryable=self._retryable)
+        self.breaker: Optional[CircuitBreaker] = (
+            CircuitBreaker(failure_threshold=5, reset_timeout_s=5.0)
+            if breaker is _DEFAULT_BREAKER else breaker)
 
     @staticmethod
     def _retryable(exc: Exception) -> bool:
         if isinstance(exc, ServingError):
             return exc.retryable
         return isinstance(exc, (ConnectionError, OSError, TimeoutError))
+
+    @staticmethod
+    def _breaker_counted(exc: Exception) -> bool:
+        """Failures that indicate an UNAVAILABLE dependency (and should
+        trip the breaker) vs. responses that merely report an error."""
+        if isinstance(exc, ServingError):
+            return exc.retryable         # 503/429: back off
+        if isinstance(exc, RetriesExhaustedError):
+            return True
+        return isinstance(exc, (ConnectionError, OSError, TimeoutError))
+
+    def _call_guarded(self, fn):
+        """Run `fn` under the circuit breaker (when enabled). Counted
+        failures open it; any server response — success OR typed
+        4xx/500 error — records success (the dependency is alive)."""
+        if self.breaker is None:
+            return fn()
+
+        def _probe_once():
+            try:
+                return True, fn(), None
+            except Exception as e:   # noqa: BLE001 - breaker boundary
+                if self._breaker_counted(e):
+                    raise             # breaker records the failure
+                return False, None, e  # alive: breaker records success
+
+        ok, result, exc = self.breaker.call(_probe_once)
+        if not ok:
+            raise exc
+        return result
 
     def _request(self, route: str, payload: Optional[dict] = None) -> dict:
         import urllib.error
@@ -254,7 +316,7 @@ class ModelClient:
             except urllib.error.HTTPError as e:
                 raise self._serving_error(e) from None
 
-        return self.retry.call(_once)
+        return self._call_guarded(lambda: self.retry.call(_once))
 
     @staticmethod
     def _serving_error(e) -> ServingError:

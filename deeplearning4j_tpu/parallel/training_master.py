@@ -489,8 +489,14 @@ class TrainingMaster:
 
         net = self.net
         os.makedirs(self.checkpoint_dir, exist_ok=True)
+        # self-describing payload (step/iteration/epoch ride inside):
+        # the fallback scan can resume position without latest.json,
+        # matching the npz format's contract
         payload = {"params": net.params, "upd": net.updater_states,
-                   "states": net.states, "rng": np.asarray(net._rng)}
+                   "states": net.states, "rng": np.asarray(net._rng),
+                   "step": np.asarray(step),
+                   "iteration": np.asarray(int(net.iteration)),
+                   "epoch": np.asarray(int(net.epoch))}
         with ocp.StandardCheckpointer() as ckptr:
             ckptr.save(self._orbax_path(step), payload, force=True)
         if jax.process_index() == 0:
@@ -498,6 +504,7 @@ class TrainingMaster:
                     "epoch": int(net.epoch), "format": "orbax"}
             _ci.atomic_write_json(
                 os.path.join(self.checkpoint_dir, "latest.json"), meta)
+            _ci.apply_retention(self.checkpoint_dir, self.keep_last)
 
     def _load_orbax(self, meta) -> int:
         import jax
@@ -512,10 +519,31 @@ class TrainingMaster:
         net.updater_states = self._replicated(data["upd"])
         net.states = self._replicated(data["states"])
         net._rng = jax.numpy.asarray(np.asarray(data["rng"]))
-        net.iteration = meta["iteration"]
-        net.epoch = meta["epoch"]
+        # meta (latest.json) may be missing during a fallback scan;
+        # newer payloads are self-describing
+        if "iteration" in meta:
+            net.iteration = meta["iteration"]
+            net.epoch = meta["epoch"]
+        elif "iteration" in data:
+            net.iteration = int(np.asarray(data["iteration"]))
+            net.epoch = int(np.asarray(data["epoch"]))
         self._staged = True
         return meta["step"]
+
+    def _orbax_steps(self):
+        return [s for s, fn in _ci.list_all_checkpoints(
+            self.checkpoint_dir) if fn.endswith(".orbax")]
+
+    def _restore_newest_valid_orbax(self) -> int:
+        """Fallback scan parity for orbax-format checkpoints: when the
+        latest pointer is damaged/missing (or points at a damaged dir),
+        restore the newest orbax directory that actually loads."""
+        for step in reversed(self._orbax_steps()):
+            try:
+                return self._load_orbax({"step": step})
+            except Exception:   # noqa: BLE001 - damaged dir: try older
+                continue
+        return 0
 
     @staticmethod
     def _structural_ok(path: str) -> None:
@@ -560,10 +588,15 @@ class TrainingMaster:
             return 0
         meta = self._read_latest_meta()
         if meta is not None and meta.get("format") == "orbax":
-            return self._load_orbax(meta)
+            try:
+                return self._load_orbax(meta)
+            except Exception:   # noqa: BLE001 - damaged target: scan
+                return self._restore_newest_valid_orbax()
         step = self._select_valid_step(meta)
         if step is None:
-            return 0
+            # no valid npz: orbax dirs saved without (or with a torn)
+            # latest pointer still count — retention/fallback parity
+            return self._restore_newest_valid_orbax()
         data = self._ckpt_retry.call(np.load, self._ckpt_path(step))
         import jax
 

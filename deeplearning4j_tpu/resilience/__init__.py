@@ -37,6 +37,7 @@ from deeplearning4j_tpu.resilience.checkpoint_integrity import (
     atomic_write_bytes,
     atomic_write_json,
     atomic_writer,
+    list_all_checkpoints,
     newest_valid_checkpoint,
     record_checksum,
     require_valid,
@@ -52,6 +53,6 @@ __all__ = [
     "FAULTS_ENV_VAR", "FaultInjector", "FaultSpec", "fire", "injector",
     "CircuitBreaker", "Retry",
     "apply_retention", "atomic_write_bytes", "atomic_write_json",
-    "atomic_writer", "newest_valid_checkpoint", "record_checksum",
-    "require_valid", "sha256_file", "validate_file",
+    "atomic_writer", "list_all_checkpoints", "newest_valid_checkpoint",
+    "record_checksum", "require_valid", "sha256_file", "validate_file",
 ]

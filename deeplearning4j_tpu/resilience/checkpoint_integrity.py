@@ -28,12 +28,15 @@ import hashlib
 import json
 import os
 import re
-from typing import Dict, Iterator, List, Optional
+import shutil
+from typing import Dict, Iterator, List, Optional, Tuple
 
 from deeplearning4j_tpu.resilience.errors import CheckpointIntegrityError
 
 MANIFEST = "manifest.json"
 _STEP_RE = re.compile(r"step-(\d+)\.npz$")
+# any step checkpoint: .npz files AND orbax directories (step-N.orbax)
+_STEP_ANY_RE = re.compile(r"step-(\d+)\.(npz|orbax)$")
 
 
 def sha256_file(path: str, chunk: int = 1 << 20) -> str:
@@ -146,6 +149,20 @@ def list_step_checkpoints(directory: str) -> List[int]:
     return sorted(steps)
 
 
+def list_all_checkpoints(directory: str) -> List[Tuple[int, str]]:
+    """Every step checkpoint in the directory as (step, filename),
+    sorted by step — BOTH .npz files and orbax directories, so
+    retention and fallback scans see one unified step timeline."""
+    if not directory or not os.path.isdir(directory):
+        return []
+    out = []
+    for fn in os.listdir(directory):
+        m = _STEP_ANY_RE.match(fn)
+        if m:
+            out.append((int(m.group(1)), fn))
+    return sorted(out)
+
+
 def newest_valid_checkpoint(directory: str,
                             structural_check=None) -> Optional[int]:
     """Newest step whose file passes checksum (and, when the manifest
@@ -166,14 +183,18 @@ def newest_valid_checkpoint(directory: str,
 
 def apply_retention(directory: str, keep_last: int) -> List[int]:
     """Prune step checkpoints beyond the newest `keep_last`; returns the
-    pruned steps. keep_last <= 0 keeps everything."""
+    pruned steps. keep_last <= 0 keeps everything. Covers .npz files
+    AND orbax checkpoint directories on one step timeline."""
     if keep_last <= 0:
         return []
-    steps = list_step_checkpoints(directory)
-    pruned = steps[:-keep_last] if len(steps) > keep_last else []
-    for step in pruned:
-        fn = f"step-{step:08d}.npz"
-        with contextlib.suppress(OSError):
-            os.remove(os.path.join(directory, fn))
-        forget_checksum(directory, fn)
-    return pruned
+    entries = list_all_checkpoints(directory)
+    pruned = entries[:-keep_last] if len(entries) > keep_last else []
+    for _, fn in pruned:
+        path = os.path.join(directory, fn)
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        else:
+            with contextlib.suppress(OSError):
+                os.remove(path)
+            forget_checksum(directory, fn)
+    return [step for step, _ in pruned]

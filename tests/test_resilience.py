@@ -332,6 +332,64 @@ def test_keep_last_retention_through_training(tmp_path):
     assert tm.list_checkpoints() == [4, 5]
 
 
+def test_retention_covers_orbax_directories(tmp_path):
+    """Satellite (orbax retention parity): keep_last pruning must see
+    npz files and orbax checkpoint DIRECTORIES on one step timeline."""
+    d = str(tmp_path)
+    for step in (1, 2):
+        p = os.path.join(d, f"step-{step:08d}.npz")
+        with atomic_writer(p, suffix=".tmp.npz") as tmp:
+            with open(tmp, "wb") as f:
+                np.savez(f, a=np.arange(step))
+            record_checksum(d, os.path.basename(p), sha256_file(tmp),
+                            os.path.getsize(tmp))
+    for step in (3, 4):
+        od = os.path.join(d, f"step-{step}.orbax")
+        os.makedirs(od)
+        with open(os.path.join(od, "payload"), "w") as f:
+            f.write("x")
+    assert apply_retention(d, keep_last=2) == [1, 2]
+    left = sorted(f for f in os.listdir(d) if f.startswith("step-"))
+    assert left == ["step-3.orbax", "step-4.orbax"]
+    # newest-2 across formats: orbax dirs pruned too
+    assert apply_retention(d, keep_last=1) == [3]
+    assert not os.path.exists(os.path.join(d, "step-3.orbax"))
+
+
+def test_orbax_training_retention_and_fallback_scan(tmp_path):
+    """Satellite (ROADMAP open item): orbax-format checkpoints honor
+    keep_last AND the newest-valid fallback scan — a missing latest
+    pointer or a damaged newest directory must not lose the run."""
+    import shutil
+
+    pytest.importorskip("orbax.checkpoint")
+    from deeplearning4j_tpu.parallel.training_master import TrainingMaster
+
+    batch = _data()
+    ck = str(tmp_path / "ck")
+    tm = TrainingMaster(_net(), checkpoint_dir=ck, checkpoint_every=1,
+                        checkpoint_format="orbax", keep_last=2)
+    tm.fit(batch, 5)
+    assert tm.list_checkpoints() == [4, 5]   # retention pruned 1..3
+
+    # fallback parity (a): latest.json gone -> scan finds step 5 and
+    # restores position from the self-describing payload
+    os.remove(os.path.join(ck, "latest.json"))
+    tm2 = TrainingMaster(_net(), checkpoint_dir=ck, checkpoint_every=1,
+                         checkpoint_format="orbax", keep_last=2)
+    assert tm2.load_latest_checkpoint() == 5
+    assert tm2.net.iteration == 5
+
+    # fallback parity (b): the newest directory is damaged -> the scan
+    # falls back to the previous valid step instead of crashing
+    shutil.rmtree(os.path.join(ck, "step-5.orbax"))
+    os.makedirs(os.path.join(ck, "step-5.orbax"))   # empty husk
+    tm3 = TrainingMaster(_net(), checkpoint_dir=ck, checkpoint_every=1,
+                         checkpoint_format="orbax", keep_last=2)
+    assert tm3.load_latest_checkpoint() == 4
+    assert tm3.net.iteration == 4
+
+
 # ====================================== serializer + earlystopping saver
 def test_write_model_is_atomic_and_checksummed(tmp_path):
     from deeplearning4j_tpu.util.model_serializer import (
@@ -644,6 +702,119 @@ def test_client_surfaces_503_with_retry_after_and_retries():
             retryable=ModelClient._retryable)).predict([[1.0]])
         assert out["outputs"] == [[1.0]]
         assert len(hits) == 3
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def _stub_http_server(handler_fn):
+    """Minimal HTTP server whose POST behavior is `handler_fn(hits) ->
+    (status, body_bytes, headers)`."""
+    import http.server
+    import socketserver
+
+    hits = []
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            hits.append(1)
+            n = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(n)
+            status, body, headers = handler_fn(len(hits))
+            self.send_response(status)
+            for k, v in headers:
+                self.send_header(k, v)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    class _S(socketserver.ThreadingMixIn, http.server.HTTPServer):
+        daemon_threads = True
+
+    httpd = _S(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, hits
+
+
+def test_model_client_has_circuit_breaker_by_default():
+    """Satellite: CircuitBreaker is wired into ModelClient BY DEFAULT
+    (was exported-but-unused); breaker=None opts out."""
+    from deeplearning4j_tpu.parallel.serving import ModelClient
+
+    assert isinstance(ModelClient("http://x").breaker, CircuitBreaker)
+    assert ModelClient("http://x", breaker=None).breaker is None
+
+
+def test_model_client_breaker_opens_on_503s_and_half_opens():
+    """Satellite: repeated 503s open the breaker (requests fail fast
+    WITHOUT hitting the server); after the cooldown one probe goes
+    through (half-open) and its success closes the circuit."""
+    from deeplearning4j_tpu.parallel.serving import ModelClient
+
+    ok = [False]
+
+    def handler(nth):
+        if ok[0]:
+            return 200, b'{"outputs": [[1.0]]}', []
+        return (503, b'{"error": "queue full", '
+                b'"error_class": "OverloadedError"}',
+                [("Retry-After", "1")])
+
+    httpd, hits = _stub_http_server(handler)
+    try:
+        now = [0.0]
+        breaker = CircuitBreaker(failure_threshold=3,
+                                 reset_timeout_s=10.0,
+                                 clock=lambda: now[0])
+        client = ModelClient(
+            f"http://127.0.0.1:{httpd.server_address[1]}",
+            retry=Retry(max_attempts=1,
+                        retryable=lambda e: False),
+            breaker=breaker)
+        for _ in range(3):
+            with pytest.raises(ServingError):
+                client.predict([[1.0]])
+        assert breaker.state == CircuitBreaker.OPEN
+        server_hits = len(hits)
+        # open circuit: fail fast, the drowning server is NOT hit
+        with pytest.raises(CircuitOpenError) as ei:
+            client.predict([[1.0]])
+        assert ei.value.retry_after_s > 0
+        assert len(hits) == server_hits
+        # cooldown elapses -> half-open -> a healthy response closes it
+        now[0] = 11.0
+        ok[0] = True
+        assert client.predict([[1.0]])["outputs"] == [[1.0]]
+        assert breaker.state == CircuitBreaker.CLOSED
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_model_client_4xx_does_not_trip_breaker():
+    """A 4xx/500 response proves the server is ALIVE — it must not
+    open the breaker (only unavailability counts)."""
+    from deeplearning4j_tpu.parallel.serving import ModelClient
+
+    def handler(nth):
+        return 400, b'{"error": "bad", "error_class": "ValueError"}', []
+
+    httpd, hits = _stub_http_server(handler)
+    try:
+        breaker = CircuitBreaker(failure_threshold=2)
+        client = ModelClient(
+            f"http://127.0.0.1:{httpd.server_address[1]}",
+            retry=Retry(max_attempts=1), breaker=breaker)
+        for _ in range(4):
+            with pytest.raises(ServingError) as ei:
+                client.predict([[1.0]])
+            assert ei.value.status == 400
+        assert breaker.state == CircuitBreaker.CLOSED
+        assert len(hits) == 4
     finally:
         httpd.shutdown()
         httpd.server_close()
