@@ -11,8 +11,9 @@ from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
 
 class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
     def __init__(self, config, net, train_iterator, workers=None,
-                 tp: int = 1, mesh=None, averaging_frequency: int = 1):
-        super().__init__(config, net, train_iterator)
+                 tp: int = 1, mesh=None, averaging_frequency: int = 1,
+                 guard=None):
+        super().__init__(config, net, train_iterator, guard=guard)
         self.wrapper = ParallelWrapper(
             net, workers=workers, tp=tp, mesh=mesh,
             averaging_frequency=averaging_frequency)

@@ -16,16 +16,60 @@ logger = logging.getLogger("deeplearning4j_tpu")
 
 
 class EarlyStoppingTrainer:
-    def __init__(self, config, net, train_iterator):
+    def __init__(self, config, net, train_iterator, guard=None):
+        """`guard` (resilience.NonFiniteGuard) checks the net after
+        (sampled) training batches: a non-finite/spiking batch is
+        skipped with the pre-batch state restored (policy='skip_step')
+        or aborts the fit (policy='abort'); 'rollback' needs
+        TrainingMaster checkpoints and is rejected here."""
+        if guard is not None and guard.policy == "rollback":
+            raise ValueError(
+                "NonFiniteGuard(policy='rollback') needs TrainingMaster "
+                "checkpoints; EarlyStoppingTrainer supports "
+                "skip_step/abort")
         self.config = config
         self.net = net
         self.train_iterator = train_iterator
+        self.guard = guard
+        self._guard_batches = 0
 
     def _fit_batch(self, batch):
         """One training batch; EarlyStoppingParallelTrainer overrides to
         route through ParallelWrapper. Uses fit_batch so the net's epoch
         counter stays under THIS trainer's control."""
         self.net.fit_batch(batch)
+
+    def _fit_batch_guarded(self, batch) -> bool:
+        """Run one batch under the guard; False = batch rejected (state
+        restored), so the caller skips score/termination checks."""
+        from deeplearning4j_tpu.resilience.errors import (
+            NonFiniteLossError,
+        )
+
+        g = self.guard
+        if g is None:
+            self._fit_batch(batch)
+            return True
+        check = g.should_check(self._guard_batches)
+        self._guard_batches += 1
+        snap = (g.snapshot(self.net)
+                if check and g.policy == "skip_step" else None)
+        self._fit_batch(batch)
+        if not check:
+            return True
+        verdict = g.post_step(self.net)
+        if verdict == "ok":
+            return True
+        if g.policy == "skip_step":
+            g.restore(self.net, snap)
+            g.note_skip()
+            logger.warning("early stopping: %s batch at epoch %d "
+                           "skipped, state restored", verdict,
+                           self.net.epoch)
+            return False
+        raise NonFiniteLossError(
+            f"{verdict} training state at epoch {self.net.epoch} "
+            "(policy=abort)")
 
     def _on_epoch_data_end(self):
         """Hook after the epoch's batch loop (parallel trainer flushes
@@ -51,7 +95,8 @@ class EarlyStoppingTrainer:
             if hasattr(self.train_iterator, "reset"):
                 self.train_iterator.reset()
             for batch in self.train_iterator:
-                self._fit_batch(batch)
+                if not self._fit_batch_guarded(batch):
+                    continue   # guard rejected the batch: state restored
                 score = net.score()
                 if score is None:
                     # Parallel trainer with averaging_frequency=k buffers

@@ -46,6 +46,7 @@ Death of EITHER pipeline stage (fault points `inference.batch` and
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
@@ -61,6 +62,12 @@ from deeplearning4j_tpu.resilience.errors import (
     ShutdownError,
 )
 from deeplearning4j_tpu.resilience.faults import fire as _fire
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+# warn once per process when warmup is silently impossible (underivable
+# input shape) — tests may reset this to re-observe the warning
+_WARMUP_SKIP_WARNED = False
 
 
 class InferenceMode:
@@ -126,8 +133,16 @@ class ParallelInference:
                  pipeline_depth: int = 2,
                  warmup: bool = True,
                  adaptive_wait: bool = True,
-                 min_wait_ms: float = 0.0):
+                 min_wait_ms: float = 0.0,
+                 warmup_inputs=None):
+        """`warmup_inputs`: per-example input shapes for nets whose
+        shape is underivable from the conf (multi-input
+        ComputationGraphs, stub nets) — a sequence with one entry per
+        network input, each either a shape tuple (no batch dim) or an
+        example array whose leading dim is the batch. Without it such
+        nets skip warmup (warned once per process)."""
         self.net = net
+        self.warmup_inputs = warmup_inputs
         self.mode = inference_mode
         self.batch_limit = batch_limit
         self.max_wait_ms = max_wait_ms
@@ -227,20 +242,50 @@ class ParallelInference:
         except Exception:   # noqa: BLE001 - underivable shape: skip
             return None
 
+    def _warmup_shapes(self) -> Optional[List[tuple]]:
+        """Per-example shape for every network input: explicit
+        `warmup_inputs` first, else derived from the conf's InputType;
+        None when underivable either way."""
+        if self.warmup_inputs is not None:
+            shapes = []
+            for w in self.warmup_inputs:
+                if isinstance(w, (tuple, list)) and all(
+                        isinstance(d, (int, np.integer)) for d in w):
+                    shapes.append(tuple(int(d) for d in w))
+                else:
+                    shapes.append(tuple(np.asarray(w).shape[1:]))
+            return shapes
+        tail = self._warmup_tail_shape()
+        return None if tail is None else [tail]
+
     def warmup(self) -> List[int]:
         """Pre-trace `net.output` for every power-of-two bucket up to
         the cap, so a mixed-size request load causes ZERO new traces
         (each one a full XLA recompile on TPU). Returns the buckets
-        traced; no-op when the input shape is underivable."""
-        tail = self._warmup_tail_shape()
-        if tail is None:
+        traced; skipped (with a once-per-process warning) when the
+        input shape is underivable and no `warmup_inputs` were given."""
+        shapes = self._warmup_shapes()
+        if shapes is None:
+            global _WARMUP_SKIP_WARNED
+            if not _WARMUP_SKIP_WARNED:
+                _WARMUP_SKIP_WARNED = True
+                logger.warning(
+                    "ParallelInference: warmup skipped — per-example "
+                    "input shape underivable (multi-input graph or "
+                    "stub net); pass warmup_inputs=[shape, ...] to "
+                    "pre-trace buckets and avoid first-request "
+                    "recompiles")
             return []
         done = []
         b = 1
         while b <= self._cap:
-            x = np.zeros((b,) + tail, np.float32)
+            xs = [np.zeros((b,) + s, np.float32) for s in shapes]
             with self._lock:
-                np.asarray(self.net.output(x))   # block: compile now
+                out = (self.net.output(*xs) if len(xs) > 1
+                       else self.net.output(xs[0]))
+                for o in (out if isinstance(out, (list, tuple))
+                          else [out]):
+                    np.asarray(o)            # block: compile now
             done.append(b)
             b <<= 1
         self._warmed_buckets = done

@@ -27,6 +27,7 @@ broadcast" recovery, made explicit.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import re
 from functools import partial
@@ -35,8 +36,22 @@ from typing import Callable, Optional, Tuple
 import numpy as np
 
 from deeplearning4j_tpu.resilience import checkpoint_integrity as _ci
+from deeplearning4j_tpu.resilience.errors import (
+    FaultInjectedError,
+    NonFiniteLossError,
+    PreemptedError,
+    StepHangError,
+)
 from deeplearning4j_tpu.resilience.faults import fire as _fire
 from deeplearning4j_tpu.resilience.retry import Retry
+from deeplearning4j_tpu.resilience.supervisor import (
+    NonFiniteGuard,
+    PreemptionHandler,
+    StepWatchdog,
+    Supervisor,
+)
+
+logger = logging.getLogger("deeplearning4j_tpu")
 
 
 class TrainingMaster:
@@ -54,7 +69,13 @@ class TrainingMaster:
                  threshold_compression: float = 0.0,
                  checkpoint_format: str = "npz",
                  keep_last: int = 0,
-                 checkpoint_retry: Optional[Retry] = None):
+                 checkpoint_retry: Optional[Retry] = None,
+                 guard: Optional[NonFiniteGuard] = None,
+                 watchdog: Optional[StepWatchdog] = None,
+                 preemption=False,
+                 data_retry: Optional[Retry] = None,
+                 skip_bad_batches: bool = False,
+                 supervisor: Optional[Supervisor] = None):
         """`averaging_frequency=k > 1` runs k-step local SGD between
         parameter rendezvous — each dp shard trains privately for k
         steps, then params (+ updater state) are averaged. This is the
@@ -95,6 +116,25 @@ class TrainingMaster:
         self._ckpt_retry = checkpoint_retry or Retry(
             max_attempts=3, initial_backoff_s=0.05,
             retryable=lambda e: isinstance(e, OSError))
+        # --- self-healing hooks (resilience/supervisor.py): all opt-in,
+        # all zero-cost when None/False
+        if guard is not None and guard.policy == "rollback" \
+                and not checkpoint_dir:
+            raise ValueError(
+                "NonFiniteGuard(policy='rollback') requires a "
+                "checkpoint_dir to roll back to")
+        self.guard = guard
+        self.watchdog = watchdog
+        if preemption is True:
+            preemption = PreemptionHandler()
+        self.preemption = preemption or None
+        self.data_retry = data_retry
+        self.skip_bad_batches = skip_bad_batches
+        self.supervisor = supervisor
+        self._poisoned_steps = set()
+        self._resil_counters = {"data_skipped_steps": 0,
+                                "grad_poisoned_steps": 0,
+                                "preemptions": 0}
         self._staged = False
         self._local_step = None
 
@@ -185,11 +225,25 @@ class TrainingMaster:
         (data staging / train step / checkpoint) retrievable via
         `training_stats()` — the Spark CommonSparkTrainingStats role
         (ref TrainingMaster.setCollectTrainingStats,
-        spark/stats/StatsUtils.java timeline export)."""
+        spark/stats/StatsUtils.java timeline export).
+
+        Self-healing (resilience/supervisor.py, all opt-in via the
+        constructor): a NonFiniteGuard checks loss+params after
+        (sampled) steps and skips/rolls-back/aborts on NaN or loss
+        spikes; a StepWatchdog heartbeats around dispatch/fetch and
+        escalates a hung step; a PreemptionHandler turns SIGTERM/SIGINT
+        (or the `train.preempt` fault) into checkpoint-then-
+        PreemptedError at the next step boundary; `data_retry` +
+        `skip_bad_batches` make a flaky batch_fn (the `data.next`
+        fault point) survivable. Run the whole fit under
+        `Supervisor.run` to also survive crashes/hangs/preemptions via
+        checkpoint resume."""
         import time
 
         self._stage_net()
         net = self.net
+        guard = self.guard
+        wd = self.watchdog
         if start_step is None:
             start_step = self.load_latest_checkpoint()
         if collect_training_stats:
@@ -200,50 +254,192 @@ class TrainingMaster:
             raise NotImplementedError(
                 "line-search solvers are not supported under "
                 "TrainingMaster; use stochastic_gradient_descent")
-        if self.averaging_frequency > 1:
-            return self._fit_local_sgd(batch_fn, num_steps, start_step,
-                                       collect_training_stats)
-        is_graph = hasattr(net.conf, "network_inputs")
-        is_tbptt = getattr(net.conf, "backprop_type", None) \
-            == "truncated_bptt"
-        with self.mesh:
-            for step in range(start_step, num_steps):
-                _fire("train.step")
-                t0 = time.perf_counter()
-                x, y = self._global_batch(*batch_fn(step))
-                t1 = time.perf_counter()
-                chunked = is_tbptt and getattr(x, "ndim", 0) == 3
-                if is_graph:
-                    name = net.conf.network_inputs[0]
-                    if chunked:
-                        net._fit_tbptt({name: x}, [y], None, None)
+        if (guard is not None and guard.policy == "rollback"
+                and self.checkpoint_dir and not self.list_checkpoints()):
+            # a rollback target must exist before the first poisoned
+            # step — seed one at the fit's starting state
+            self.save_checkpoint(start_step)
+        if self.preemption is not None:
+            self.preemption.install()
+        if wd is not None:
+            wd.start()
+        try:
+            if self.averaging_frequency > 1:
+                return self._fit_local_sgd(batch_fn, num_steps,
+                                           start_step,
+                                           collect_training_stats)
+            is_graph = hasattr(net.conf, "network_inputs")
+            is_tbptt = getattr(net.conf, "backprop_type", None) \
+                == "truncated_bptt"
+            with self.mesh:
+                step = start_step
+                while step < num_steps:
+                    if step in self._poisoned_steps:
+                        step += 1   # rollback replay: skip the poisoned
+                        continue    # data window, train nothing on it
+                    self._check_preemption(step)
+                    _fire("train.step")
+                    _fire("train.hang")
+                    if wd is not None:
+                        wd.beat("dispatch")
+                    t0 = time.perf_counter()
+                    batch = self._next_batch(batch_fn, step)
+                    if batch is None:       # bad batch skipped by policy
+                        step += 1
+                        continue
+                    x, y = self._global_batch(
+                        self._maybe_poison(batch[0]), batch[1])
+                    t1 = time.perf_counter()
+                    done = step + 1
+                    ckpt_due = bool(
+                        self.checkpoint_dir and self.checkpoint_every
+                        and done % self.checkpoint_every == 0)
+                    # a checkpoint must never publish non-finite state:
+                    # force a check on checkpoint steps even when the
+                    # sampling cadence would skip them
+                    check_now = guard is not None and (
+                        guard.should_check(step)
+                        or (ckpt_due and guard.check_every > 0))
+                    snap = (guard.snapshot(net)
+                            if check_now and guard.policy == "skip_step"
+                            else None)
+                    chunked = is_tbptt and getattr(x, "ndim", 0) == 3
+                    if is_graph:
+                        name = net.conf.network_inputs[0]
+                        if chunked:
+                            net._fit_tbptt({name: x}, [y], None, None)
+                        else:
+                            net._train_step({name: x}, [y])
+                    elif chunked:
+                        net._fit_tbptt(x, y, None, None)
                     else:
-                        net._train_step({name: x}, [y])
-                elif chunked:
-                    net._fit_tbptt(x, y, None, None)
-                else:
-                    net._train_step(x, y)
-                if collect_training_stats:
-                    # host fetch = true step barrier for honest timing
-                    float(net.score())
-                t2 = time.perf_counter()
-                for listener in net.listeners:
-                    listener.iteration_done(net, net.iteration)
-                t3 = time.perf_counter()
-                done = step + 1
-                if (self.checkpoint_dir and self.checkpoint_every
-                        and done % self.checkpoint_every == 0):
-                    self.save_checkpoint(done)
-                if collect_training_stats:
-                    self._stats.append({
-                        "step": step,
-                        "data_ms": (t1 - t0) * 1e3,
-                        "fit_ms": (t2 - t1) * 1e3,
-                        "listener_ms": (t3 - t2) * 1e3,
-                        "checkpoint_ms":
-                            (time.perf_counter() - t3) * 1e3,
-                    })
+                        net._train_step(x, y)
+                    if wd is not None:
+                        wd.beat("fetch")
+                    if check_now:
+                        verdict = guard.post_step(net)
+                        if verdict != "ok":
+                            if guard.policy == "skip_step":
+                                guard.restore(net, snap)
+                                guard.note_skip()
+                                logger.warning(
+                                    "guard: %s at step %d — step "
+                                    "skipped, state restored",
+                                    verdict, step)
+                                step += 1
+                                continue
+                            if guard.policy == "rollback":
+                                step = self._rollback(step, verdict)
+                                continue
+                            raise NonFiniteLossError(
+                                f"{verdict} training state at step "
+                                f"{step} (policy=abort)")
+                    if collect_training_stats:
+                        # host fetch = true step barrier for honest
+                        # timing
+                        float(net.score())
+                    t2 = time.perf_counter()
+                    for listener in net.listeners:
+                        listener.iteration_done(net, net.iteration)
+                    t3 = time.perf_counter()
+                    if ckpt_due:
+                        self.save_checkpoint(done)
+                    if collect_training_stats:
+                        self._stats.append({
+                            "step": step,
+                            "data_ms": (t1 - t0) * 1e3,
+                            "fit_ms": (t2 - t1) * 1e3,
+                            "listener_ms": (t3 - t2) * 1e3,
+                            "checkpoint_ms":
+                                (time.perf_counter() - t3) * 1e3,
+                        })
+                    step += 1
+        finally:
+            if wd is not None:
+                wd.stop()
+            if self.preemption is not None:
+                self.preemption.uninstall()
         return self
+
+    # ------------------------------------------------------- self-healing
+    def _next_batch(self, batch_fn, step):
+        """Fetch this step's batch through the `data.next` fault point,
+        retried per `data_retry`; returns None (skip the step) when the
+        fetch ultimately fails and `skip_bad_batches` is set."""
+        def get():
+            _fire("data.next")
+            return batch_fn(step)
+
+        try:
+            if self.data_retry is not None:
+                return self.data_retry.call(get)
+            return get()
+        except (StepHangError, PreemptedError):
+            raise          # escalations, not data failures
+        except Exception:
+            if self.skip_bad_batches:
+                self._resil_counters["data_skipped_steps"] += 1
+                logger.warning("data.next failed at step %d — step "
+                               "skipped (skip_bad_batches)", step)
+                return None
+            raise
+
+    def _maybe_poison(self, x):
+        """`train.grad_nonfinite` chaos hook: a triggered fire is
+        consumed by poisoning the batch with NaN, so non-finite
+        loss/grads flow through the REAL step math (what the guard must
+        catch), not a synthetic exception."""
+        try:
+            _fire("train.grad_nonfinite")
+        except FaultInjectedError:
+            self._resil_counters["grad_poisoned_steps"] += 1
+            x = np.full(np.shape(x), np.nan, np.float32)
+        return x
+
+    def _check_preemption(self, step):
+        """Step-boundary preemption check: a pending SIGTERM/SIGINT (or
+        a triggered `train.preempt` fault) checkpoints the CURRENT state
+        and raises PreemptedError — a preempted job loses zero completed
+        steps and a Supervisor (or a relaunch) resumes exactly here."""
+        requested = False
+        try:
+            _fire("train.preempt")
+        except FaultInjectedError:
+            requested = True
+            if self.preemption is not None:
+                self.preemption.request(simulated=True)
+        if self.preemption is not None and self.preemption.requested:
+            requested = True
+        if not requested:
+            return
+        self._resil_counters["preemptions"] += 1
+        if self.preemption is not None:
+            self.preemption.counters["preemptions"] += 1
+            self.preemption.clear()   # a supervised restart may resume
+        if self.checkpoint_dir:
+            self.save_checkpoint(step)
+        raise PreemptedError(
+            f"preempted at step {step}"
+            + ("; checkpoint saved" if self.checkpoint_dir else ""),
+            step=step)
+
+    def _rollback(self, poisoned_step, verdict) -> int:
+        """Guard policy 'rollback': mark the poisoned step so the
+        replay skips it, restore the newest valid checkpoint, and
+        return the step to resume from."""
+        guard = self.guard
+        guard.note_rollback()
+        if guard.counters["rollbacks"] > guard.max_rollbacks:
+            raise NonFiniteLossError(
+                f"guard exceeded max_rollbacks={guard.max_rollbacks} "
+                f"(last verdict {verdict} at step {poisoned_step})")
+        self._poisoned_steps.add(poisoned_step)
+        restored = self.load_latest_checkpoint()
+        logger.warning(
+            "guard: %s at step %d — rolled back to checkpoint step %d; "
+            "step %d will be skipped on replay", verdict, poisoned_step,
+            restored, poisoned_step)
+        return restored
 
     def _fit_local_sgd(self, batch_fn, num_steps, start_step,
                        collect_training_stats=False):
@@ -257,6 +453,8 @@ class TrainingMaster:
         from deeplearning4j_tpu.parallel.wrapper import LocalStepTrainer
 
         net = self.net
+        guard = self.guard
+        wd = self.watchdog
         k = self.averaging_frequency
         if self._local_step is None:
             self._local_step = LocalStepTrainer(
@@ -267,25 +465,69 @@ class TrainingMaster:
         with self.mesh:
             step = start_step
             while step < num_steps:
+                self._check_preemption(step)
                 _fire("train.step")
+                _fire("train.hang")
+                if wd is not None:
+                    wd.beat("dispatch")
                 t0 = time.perf_counter()
-                group = [batch_fn(s)
-                         for s in range(step, min(step + k, num_steps))]
+                span = min(step + k, num_steps) - step
+                group = []
+                for s in range(step, step + span):
+                    if s in self._poisoned_steps:
+                        continue   # rollback replay: skip poisoned data
+                    b = self._next_batch(batch_fn, s)
+                    if b is not None:
+                        group.append((self._maybe_poison(b[0]), b[1]))
+                if not group:
+                    step += span
+                    continue
                 xs = self._stage(np.stack([g[0] for g in group]),
                                  P(None, "dp"))
                 ys = self._stage(np.stack([g[1] for g in group]),
                                  P(None, "dp"))
                 t1 = time.perf_counter()
+                # guard at group granularity: one check per rendezvous
+                # (already a 1/k sampling of the underlying steps)
+                check_now = guard is not None and guard.check_every > 0
+                snap = (guard.snapshot(net)
+                        if check_now and guard.policy == "skip_step"
+                        else None)
                 if is_graph:
                     name = net.conf.network_inputs[0]
                     self._local_step.run_arrays({name: xs}, [ys])
                 else:
                     self._local_step.run_arrays(xs, ys)
+                if wd is not None:
+                    wd.beat("fetch")
+                if check_now:
+                    verdict = guard.post_step(net)
+                    if verdict != "ok":
+                        if guard.policy == "skip_step":
+                            guard.restore(net, snap)
+                            guard.note_skip()
+                            step += span
+                            continue
+                        if guard.policy == "rollback":
+                            # the whole group is the poisoned window
+                            for s in range(step, step + span):
+                                self._poisoned_steps.add(s)
+                            guard.note_rollback()
+                            if guard.counters["rollbacks"] \
+                                    > guard.max_rollbacks:
+                                raise NonFiniteLossError(
+                                    "guard exceeded max_rollbacks="
+                                    f"{guard.max_rollbacks}")
+                            step = self.load_latest_checkpoint()
+                            continue
+                        raise NonFiniteLossError(
+                            f"{verdict} training state in group at "
+                            f"step {step} (policy=abort)")
                 if collect_training_stats:
                     float(net.score())
                 t2 = time.perf_counter()
                 prev = step
-                step += len(group)
+                step += span
                 # checkpoint when the group CROSSES a cadence boundary
                 # (group ends rarely align with checkpoint_every)
                 if (self.checkpoint_dir and every
@@ -293,7 +535,7 @@ class TrainingMaster:
                     self.save_checkpoint(step)
                 if collect_training_stats:
                     self._stats.append({
-                        "step": step - len(group),
+                        "step": prev,
                         "data_ms": (t1 - t0) * 1e3,
                         "fit_ms": (t2 - t1) * 1e3,
                         "listener_ms": 0.0,
@@ -305,17 +547,42 @@ class TrainingMaster:
     def training_stats(self):
         """Per-step phase timings recorded when fit(...,
         collect_training_stats=True) — the CommonSparkTrainingStats
-        equivalent. Returns a list of dicts plus an aggregate row."""
+        equivalent. Returns a list of dicts plus an aggregate row, and a
+        `resilience` block (guard / watchdog / preemption / supervisor
+        counters) whenever any self-healing hook is attached."""
         stats = list(getattr(self, "_stats", []))
         wire = (self._local_step.wire_stats()
                 if self._local_step is not None else None)
+        resil = self.resilience_stats()
         if not stats:
-            return {"steps": [], "summary": {}, "wire": wire}
+            return {"steps": [], "summary": {}, "wire": wire,
+                    "resilience": resil}
         summary = {
             k: float(np.mean([s[k] for s in stats]))
             for k in ("data_ms", "fit_ms", "listener_ms", "checkpoint_ms")
         }
-        return {"steps": stats, "summary": summary, "wire": wire}
+        return {"steps": stats, "summary": summary, "wire": wire,
+                "resilience": resil}
+
+    def resilience_stats(self):
+        """Guard / watchdog / preemption / restart counters (None when
+        no self-healing hook is attached and nothing was counted)."""
+        out = {
+            "guard": self.guard.stats() if self.guard else None,
+            "watchdog": self.watchdog.stats() if self.watchdog else None,
+            "preemption": (self.preemption.stats()
+                           if self.preemption else None),
+            "supervisor": (self.supervisor.stats()
+                           if self.supervisor else None),
+            "counters": dict(self._resil_counters),
+            "poisoned_steps": sorted(self._poisoned_steps),
+        }
+        if (all(v is None for k, v in out.items()
+                if k not in ("counters", "poisoned_steps"))
+                and not any(self._resil_counters.values())
+                and not self._poisoned_steps):
+            return None
+        return out
 
     def export_stats_html(self, path: str):
         """Timeline HTML export (ref StatsUtils.exportStatsAsHtml)."""
@@ -327,11 +594,14 @@ class TrainingMaster:
             f"<td>{s['fit_ms']:.2f}</td>"
             f"<td>{s['checkpoint_ms']:.2f}</td></tr>"
             for s in data["steps"])
+        resil = ("" if data.get("resilience") is None else
+                 f"<p>resilience: {_json.dumps(data['resilience'])}</p>")
         page = (
             "<!DOCTYPE html><html><head><meta charset='utf-8'>"
             "<title>training timeline</title></head><body>"
             f"<h1>TrainingMaster timeline</h1>"
             f"<p>summary: {_json.dumps(data['summary'])}</p>"
+            f"{resil}"
             "<table border='1'><tr><th>step</th><th>data ms</th>"
             "<th>fit ms</th><th>checkpoint ms</th></tr>"
             f"{rows}</table></body></html>")
@@ -500,6 +770,10 @@ class TrainingMaster:
         with ocp.StandardCheckpointer() as ckptr:
             ckptr.save(self._orbax_path(step), payload, force=True)
         if jax.process_index() == 0:
+            # integrity parity with the .npz path: per-file sha256
+            # sidecar inside the orbax dir, verified before any restore
+            # so the fallback scan skips torn directories
+            _ci.write_tree_manifest(self._orbax_path(step))
             meta = {"step": step, "iteration": int(net.iteration),
                     "epoch": int(net.epoch), "format": "orbax"}
             _ci.atomic_write_json(
@@ -513,6 +787,9 @@ class TrainingMaster:
         net = self.net
         if net.params is None:
             net.init()
+        # torn/tampered orbax dir: raise BEFORE restore so the caller's
+        # fallback scan moves on to the next-newest candidate
+        _ci.require_valid_tree(self._orbax_path(meta["step"]))
         with ocp.StandardCheckpointer() as ckptr:
             data = ckptr.restore(self._orbax_path(meta["step"]))
         net.params = self._replicated(data["params"])

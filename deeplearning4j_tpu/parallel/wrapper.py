@@ -74,11 +74,25 @@ class ParallelWrapper:
     def __init__(self, net, workers: Optional[int] = None, tp: int = 1,
                  averaging_frequency: int = 1, average_updaters: bool = True,
                  mesh: Optional[Mesh] = None, prefetch_buffer: int = 2,
-                 threshold_compression: float = 0.0):
+                 threshold_compression: float = 0.0,
+                 guard=None, watchdog=None):
+        """`guard`/`watchdog` (resilience/supervisor.py) give fit() the
+        same self-healing hooks as TrainingMaster: the NonFiniteGuard
+        checks loss+params after (sampled) steps and skips or aborts on
+        non-finite state (`rollback` needs TrainingMaster checkpoints
+        and is rejected here); the StepWatchdog heartbeats per batch and
+        escalates a hung step/collective."""
         self.net = net
         self.threshold_compression = float(threshold_compression)
         _require_local_sgd(averaging_frequency,
                            self.threshold_compression)
+        if guard is not None and guard.policy == "rollback":
+            raise ValueError(
+                "NonFiniteGuard(policy='rollback') needs TrainingMaster "
+                "checkpoints; ParallelWrapper supports skip_step/abort")
+        self.guard = guard
+        self.watchdog = watchdog
+        self._guard_steps = 0
         if mesh is None:
             n = len(jax.devices())
             workers = workers if workers is not None else max(1, n // tp)
@@ -128,6 +142,32 @@ class ParallelWrapper:
     def _pad_with_masks(self, x, y, fm, lm):
         return _pad_batch_with_masks(self.dp, x, y, fm, lm)
 
+    def _run_guarded(self, thunk) -> bool:
+        """Run one training step/group under the NonFiniteGuard; False
+        means the step was rejected and the pre-step state restored
+        (callers skip listeners for rejected steps)."""
+        from deeplearning4j_tpu.resilience.errors import (
+            NonFiniteLossError,
+        )
+
+        g = self.guard
+        check = g is not None and g.should_check(self._guard_steps)
+        self._guard_steps += 1
+        snap = (g.snapshot(self.net)
+                if check and g.policy == "skip_step" else None)
+        thunk()
+        if not check:
+            return True
+        verdict = g.post_step(self.net)
+        if verdict == "ok":
+            return True
+        if g.policy == "skip_step":
+            g.restore(self.net, snap)
+            g.note_skip()
+            return False
+        raise NonFiniteLossError(
+            f"{verdict} training state detected (policy=abort)")
+
     # ------------------------------------------------------------------
     def fit(self, data, epochs: int = 1):
         """Train. `data` is any iterator/list of batches the wrapped net
@@ -148,23 +188,41 @@ class ParallelWrapper:
             self._local_step = LocalStepTrainer(
                 net, self.mesh, average_updaters=self.average_updaters,
                 threshold=self.threshold_compression)
+        wd = self.watchdog
+        if wd is not None:
+            wd.start()
+        try:
+            self._fit_loop(batches, epochs, k, wd)
+        finally:
+            if wd is not None:
+                wd.stop()
+        return self
+
+    def _fit_loop(self, batches, epochs, k, wd):
+        net = self.net
         with self.mesh:
             for _ in range(epochs):
                 if hasattr(batches, "reset"):
                     batches.reset()
                 group = []
                 for batch in batches:
+                    if wd is not None:
+                        wd.beat("batch")
                     if getattr(self, "_multi_io", False):
-                        self._fit_multi_io(batch)
-                        for listener in net.listeners:
-                            listener.iteration_done(net, net.iteration)
+                        if self._run_guarded(
+                                lambda b=batch: self._fit_multi_io(b)):
+                            for listener in net.listeners:
+                                listener.iteration_done(net,
+                                                        net.iteration)
                         continue
                     x, y, fm, lm = self._pad_with_masks(*_as_batch(batch))
                     if k > 1:
                         group.append((x, y, fm, lm))
                         if len(group) == k:
-                            self._local_step.run(group)
+                            g = group
                             group = []
+                            self._run_guarded(
+                                lambda: self._local_step.run(g))
                         continue
                     xb = shard_batch(self.mesh, jnp.asarray(x, net.dtype))
                     yb = shard_batch(self.mesh, jnp.asarray(y, net.dtype))
@@ -182,30 +240,37 @@ class ParallelWrapper:
                     is_tbptt = (getattr(net.conf, "backprop_type", None)
                                 == "truncated_bptt"
                                 and getattr(xb, "ndim", 0) == 3)
-                    if hasattr(net.conf, "network_inputs"):
-                        # ComputationGraph: dict inputs / list labels
-                        name = net.conf.network_inputs[0]
-                        ins = {name: xb}
-                        fms_in = None if fmb is None else {name: fmb}
-                        lms_in = None if lmb is None else [lmb]
-                        if is_tbptt:
-                            net._fit_tbptt(ins, [yb], fms_in, lms_in)
+
+                    def one_step(xb=xb, yb=yb, fmb=fmb, lmb=lmb,
+                                 is_tbptt=is_tbptt):
+                        if hasattr(net.conf, "network_inputs"):
+                            # ComputationGraph: dict inputs / list labels
+                            name = net.conf.network_inputs[0]
+                            ins = {name: xb}
+                            fms_in = None if fmb is None else {name: fmb}
+                            lms_in = None if lmb is None else [lmb]
+                            if is_tbptt:
+                                net._fit_tbptt(ins, [yb], fms_in, lms_in)
+                            else:
+                                net._train_step(ins, [yb], fms_in,
+                                                lms_in)
+                        elif is_tbptt:
+                            # time-chunked steps with carried RNN state;
+                            # the sharded batch dim flows through the
+                            # chunk slices
+                            net._fit_tbptt(xb, yb, fmb, lmb)
                         else:
-                            net._train_step(ins, [yb], fms_in, lms_in)
-                    elif is_tbptt:
-                        # time-chunked steps with carried RNN state; the
-                        # sharded batch dim flows through the chunk slices
-                        net._fit_tbptt(xb, yb, fmb, lmb)
-                    else:
-                        net._train_step(xb, yb, fmb, lmb)
-                    for listener in net.listeners:
-                        listener.iteration_done(net, net.iteration)
+                            net._train_step(xb, yb, fmb, lmb)
+
+                    if self._run_guarded(one_step):
+                        for listener in net.listeners:
+                            listener.iteration_done(net, net.iteration)
                 if group:
                     # trailing group smaller than k: run it as a shorter
                     # local-step stack (compiled once per distinct size)
-                    self._local_step.run(group)
+                    g = group
+                    self._run_guarded(lambda: self._local_step.run(g))
                 net.epoch += 1
-        return self
 
     def _fit_multi_io(self, batch):
         """Multi-input/multi-output graph batch: shard every input,

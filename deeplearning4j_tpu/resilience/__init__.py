@@ -18,14 +18,19 @@ from deeplearning4j_tpu.resilience.errors import (
     DeadlineExceededError,
     FaultInjectedError,
     InferenceUnavailableError,
+    NonFiniteLossError,
     OverloadedError,
+    PreemptedError,
     ResilienceError,
+    RestartsExhaustedError,
     RetriesExhaustedError,
     ServingError,
     ShutdownError,
+    StepHangError,
 )
 from deeplearning4j_tpu.resilience.faults import (
     ENV_VAR as FAULTS_ENV_VAR,
+    REGISTERED_POINTS,
     FaultInjector,
     FaultSpec,
     fire,
@@ -41,18 +46,33 @@ from deeplearning4j_tpu.resilience.checkpoint_integrity import (
     newest_valid_checkpoint,
     record_checksum,
     require_valid,
+    require_valid_tree,
     sha256_file,
     validate_file,
+    validate_tree,
+    write_tree_manifest,
+)
+from deeplearning4j_tpu.resilience.supervisor import (
+    NonFiniteGuard,
+    PreemptionHandler,
+    StepWatchdog,
+    Supervisor,
 )
 
 __all__ = [
     "CheckpointIntegrityError", "CircuitOpenError",
     "DeadlineExceededError", "FaultInjectedError",
-    "InferenceUnavailableError", "OverloadedError", "ResilienceError",
+    "InferenceUnavailableError", "NonFiniteLossError", "OverloadedError",
+    "PreemptedError", "ResilienceError", "RestartsExhaustedError",
     "RetriesExhaustedError", "ServingError", "ShutdownError",
-    "FAULTS_ENV_VAR", "FaultInjector", "FaultSpec", "fire", "injector",
+    "StepHangError",
+    "FAULTS_ENV_VAR", "REGISTERED_POINTS", "FaultInjector", "FaultSpec",
+    "fire", "injector",
     "CircuitBreaker", "Retry",
+    "NonFiniteGuard", "PreemptionHandler", "StepWatchdog", "Supervisor",
     "apply_retention", "atomic_write_bytes", "atomic_write_json",
     "atomic_writer", "list_all_checkpoints", "newest_valid_checkpoint",
-    "record_checksum", "require_valid", "sha256_file", "validate_file",
+    "record_checksum", "require_valid", "require_valid_tree",
+    "sha256_file", "validate_file", "validate_tree",
+    "write_tree_manifest",
 ]

@@ -17,8 +17,11 @@ checkpoint. This module provides both halves:
                             file is damaged
   apply_retention           keep_last pruning of step files + manifest
 
-Orbax-format checkpoints keep their own internal integrity story;
-manifest parity for them is an open item (ROADMAP).
+Orbax-format checkpoints get the same story through a *tree manifest*
+(`manifest.sha256.json` written inside each `step-N.orbax` directory):
+per-file sha256 + size recorded at save, verified before restore, so a
+torn orbax directory is skipped by the newest-valid fallback scan
+exactly like a torn .npz.
 """
 
 from __future__ import annotations
@@ -135,6 +138,61 @@ def require_valid(directory: str, filename: str) -> None:
         raise CheckpointIntegrityError(
             f"{filename} in {directory} failed checksum validation "
             "(truncated or torn write?)")
+
+
+# ------------------------------------------------------------ tree manifest
+TREE_MANIFEST = "manifest.sha256.json"
+
+
+def write_tree_manifest(directory: str) -> Dict[str, dict]:
+    """Record {relpath: {sha256, size}} for every file under
+    `directory` (the orbax-dir integrity sidecar, written atomically
+    after the checkpointer finishes). Returns the entries."""
+    entries: Dict[str, dict] = {}
+    for root, _, files in os.walk(directory):
+        for fn in files:
+            path = os.path.join(root, fn)
+            rel = os.path.relpath(path, directory)
+            if rel == TREE_MANIFEST:
+                continue
+            entries[rel] = {"sha256": sha256_file(path),
+                            "size": os.path.getsize(path)}
+    atomic_write_json(os.path.join(directory, TREE_MANIFEST), entries)
+    return entries
+
+
+def validate_tree(directory: str) -> bool:
+    """True iff every file recorded in the directory's tree manifest
+    matches (size + sha256). Directories without a manifest pass on
+    existence alone (pre-parity checkpoints rely on the format's own
+    integrity story)."""
+    if not os.path.isdir(directory):
+        return False
+    mp = os.path.join(directory, TREE_MANIFEST)
+    if not os.path.exists(mp):
+        return True
+    try:
+        with open(mp) as f:
+            entries = json.load(f)
+    except (OSError, ValueError):
+        return False
+    for rel, ent in entries.items():
+        path = os.path.join(directory, rel)
+        try:
+            if os.path.getsize(path) != ent["size"]:
+                return False
+            if sha256_file(path) != ent["sha256"]:
+                return False
+        except OSError:
+            return False
+    return True
+
+
+def require_valid_tree(directory: str) -> None:
+    if not validate_tree(directory):
+        raise CheckpointIntegrityError(
+            f"{directory} failed tree-manifest validation "
+            "(torn orbax directory?)")
 
 
 # ----------------------------------------------------------------- recovery

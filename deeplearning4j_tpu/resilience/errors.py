@@ -66,6 +66,38 @@ class CheckpointIntegrityError(ResilienceError):
     """A checkpoint/model file failed checksum or structural validation."""
 
 
+class NonFiniteLossError(ResilienceError):
+    """Non-finite loss/params (or an unrecoverable loss spike) detected
+    by the training guard — raised by policy='abort', or when a
+    skip/rollback policy exhausted its recovery budget."""
+
+
+class StepHangError(ResilienceError):
+    """The step watchdog saw no heartbeat within its timeout: a hung
+    collective, data iterator, or host sync. Raised *in the training
+    thread* (via signal) so the job crashes restartably instead of
+    wedging forever."""
+
+
+class PreemptedError(ResilienceError):
+    """Preemption (SIGTERM/SIGINT or the `train.preempt` fault) was
+    requested; training state was checkpointed before raising."""
+
+    def __init__(self, msg: str, step: int | None = None):
+        super().__init__(msg)
+        self.step = step
+
+
+class RestartsExhaustedError(ResilienceError):
+    """Supervisor gave up restarting; `cause` is the final crash and
+    `ledger` the full restart history."""
+
+    def __init__(self, msg: str, cause: Exception, ledger: list):
+        super().__init__(msg)
+        self.cause = cause
+        self.ledger = ledger
+
+
 class ServingError(ResilienceError):
     """HTTP error surfaced by ModelClient with the server's own story.
 

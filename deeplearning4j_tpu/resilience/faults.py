@@ -15,11 +15,29 @@ Fault points wired through the stack:
                      atomic publish — `raise` simulates a kill mid-write,
                      `truncate` simulates a torn/partial write that
                      defeats a non-atomic filesystem
-  train.step         TrainingMaster.fit, once per global step
+  train.step         TrainingMaster.fit, once per global step —
+                     `raise` kills the fit mid-run (worker-loss drill;
+                     the Supervisor resumes from the newest checkpoint)
+  train.hang         TrainingMaster.fit, once per step — `delay` wedges
+                     the loop so the StepWatchdog escalation fires
+  train.preempt      TrainingMaster.fit, once per step — `raise` is
+                     consumed as a simulated TPU preemption (the loop
+                     checkpoints and raises PreemptedError)
+  train.grad_nonfinite  TrainingMaster.fit, once per step — `raise` is
+                     consumed by poisoning that step's batch with NaN,
+                     driving real non-finite loss/grads through the
+                     step (NonFiniteGuard drill)
+  data.next          around every batch_fn fetch — `raise` simulates a
+                     flaky data iterator (retried/skipped per policy)
   inference.batch    ParallelInference batcher loop, once per cycle —
                      `raise` kills the batcher thread (graceful-
                      degradation drill for the serving path)
+  inference.complete ParallelInference completion stage, once per cycle
   serve.request      ModelServer request handler, once per POST
+
+`REGISTERED_POINTS` is the canonical registry: every `fire(...)` site
+in the package must use a name listed there, and the test suite pins
+that every registered point is exercised by at least one test.
 
 Env var grammar (comma-separated specs):
 
@@ -46,6 +64,21 @@ from deeplearning4j_tpu.resilience.errors import FaultInjectedError
 
 ENV_VAR = "DL4J_TPU_FAULTS"
 _MODES = ("raise", "delay", "truncate")
+
+# every instrumented fault point in the package (see module docstring);
+# tests/test_selfhealing.py asserts source sites and this registry agree
+# and that each point is exercised by at least one test
+REGISTERED_POINTS = frozenset({
+    "checkpoint.write",
+    "data.next",
+    "inference.batch",
+    "inference.complete",
+    "serve.request",
+    "train.grad_nonfinite",
+    "train.hang",
+    "train.preempt",
+    "train.step",
+})
 
 
 @dataclass

@@ -34,6 +34,7 @@ _PAGE = """<!DOCTYPE html>
 <h1>Training session <code>{session}</code></h1>
 <p class="meta">{n} reports · final score {final_score} ·
  {sps} samples/sec · ETL {etl} ms · device mem {dev_mem} MB</p>
+<div id="resil"></div>
 <div id="charts" class="row"></div>
 <h2>Parameter mean magnitudes (log10)</h2>
 <div id="pmm" class="row"></div>
@@ -49,6 +50,26 @@ _PAGE = """<!DOCTYPE html>
 <div id="tsne" class="row"></div>
 <script>
 const DATA = {data};
+if (DATA.resilience) {{
+  // self-healing counters (guard skips/rollbacks, watchdog hangs,
+  // preemptions, supervisor restarts) from training_stats()
+  const R = DATA.resilience, parts = [];
+  if (R.guard) parts.push(`guard[${{R.guard.policy}}]: ` +
+    `${{R.guard.checks}} checks, ${{R.guard.nonfinite}} non-finite, ` +
+    `${{R.guard.spikes}} spikes, ${{R.guard.skipped_steps}} skipped, ` +
+    `${{R.guard.rollbacks}} rollbacks`);
+  if (R.watchdog) parts.push(
+    `watchdog: ${{R.watchdog.hangs_detected}} hangs detected`);
+  if (R.preemption) parts.push(
+    `preemptions: ${{R.preemption.preemptions}}`);
+  if (R.supervisor) parts.push(
+    `supervisor restarts: ${{R.supervisor.restarts}}` +
+    `/${{R.supervisor.max_restarts}}`);
+  if (R.counters) parts.push(
+    `data-skipped steps: ${{R.counters.data_skipped_steps}}`);
+  document.getElementById('resil').innerHTML =
+    '<p class="meta">self-healing — ' + parts.join(' · ') + '</p>';
+}}
 function svgLine(pts, w, h, color) {{
   if (pts.length === 0) return '';
   const xs = pts.map(p => p[0]), ys = pts.map(p => p[1]);
@@ -311,12 +332,15 @@ def embedding_scatter(vectors, labels=None, perplexity: float = 20.0,
 
 def render_html(storage: StatsStorage, session_id: Optional[str] = None,
                 path: Optional[str] = None, activations=None,
-                embedding=None, flow=None) -> str:
+                embedding=None, flow=None, resilience=None) -> str:
     """Render a self-contained HTML report; write to `path` if given.
     Defaults to the storage's only (or first) session. `activations`
     (collect_conv_activations), `embedding` (embedding_scatter) and
     `flow` (collect_network_flow) fill the conv-activation, t-SNE and
-    network-graph tabs."""
+    network-graph tabs; `resilience`
+    (TrainingMaster.resilience_stats()) renders the self-healing
+    counter line (guard skips/rollbacks, watchdog hangs, preemptions,
+    supervisor restarts)."""
     sessions = storage.session_ids()
     if not sessions:
         raise ValueError("storage has no sessions")
@@ -337,7 +361,8 @@ def render_html(storage: StatsStorage, session_id: Optional[str] = None,
         data=json.dumps({"reports": [r.to_dict() for r in reports],
                          "activations": activations,
                          "embedding": embedding,
-                         "flow": flow}),
+                         "flow": flow,
+                         "resilience": resilience}),
     )
     if path:
         with open(path, "w") as f:

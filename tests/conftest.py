@@ -76,3 +76,26 @@ def _clear_faults():
     injector().clear()
     yield
     injector().clear()
+
+
+@pytest.fixture(autouse=True)
+def _restore_signal_handlers():
+    """Chaos isolation for signals: preemption/watchdog tests install
+    SIGTERM/SIGINT/SIGUSR1 handlers (PreemptionHandler, StepWatchdog);
+    whatever a test leaves behind is restored so no handler leaks into
+    the next test. (SIGALRM is owned by _hang_guard above.)"""
+    import signal
+    import threading
+
+    if threading.current_thread() is not threading.main_thread():
+        yield
+        return
+    names = [n for n in ("SIGTERM", "SIGINT", "SIGUSR1")
+             if hasattr(signal, n)]
+    saved = {n: signal.getsignal(getattr(signal, n)) for n in names}
+    yield
+    for n, handler in saved.items():
+        try:
+            signal.signal(getattr(signal, n), handler)
+        except (ValueError, OSError, TypeError):
+            pass

@@ -58,6 +58,10 @@ def main():
     ap.add_argument("--checkpoint-every", type=int, default=0)
     ap.add_argument("--averaging-frequency", type=int, default=1)
     ap.add_argument("--threshold-compression", type=float, default=0.0)
+    # run fit under a bounded-restart Supervisor (max_restarts=N):
+    # crashes (e.g. an armed train.step fault simulating worker loss)
+    # resume from the newest valid checkpoint instead of failing the job
+    ap.add_argument("--supervise", type=int, default=0)
     args = ap.parse_args()
 
     from deeplearning4j_tpu.parallel.training_master import TrainingMaster
@@ -84,7 +88,16 @@ def main():
         return x[s:s + per], y[s:s + per]
 
     steps = args.stop_after or args.steps
-    tm.fit(batch_fn, steps)
+    restarts = 0
+    if args.supervise:
+        from deeplearning4j_tpu.resilience.supervisor import Supervisor
+
+        sup = Supervisor(max_restarts=args.supervise,
+                         initial_backoff_s=0.2, max_backoff_s=1.0)
+        sup.run(tm.fit, batch_fn, steps)
+        restarts = len(sup.restart_ledger)
+    else:
+        tm.fit(batch_fn, steps)
 
     if args.stop_after:
         # simulated kill: exit without finishing; checkpoints remain
@@ -96,7 +109,8 @@ def main():
         leaves = [TrainingMaster._host_leaf(l)
                   for l in jax.tree_util.tree_leaves(net.params)]
         extras = {"score": float(net.score()),
-                  "iteration": net.iteration}
+                  "iteration": net.iteration,
+                  "restarts": restarts}
         if args.threshold_compression > 0.0:
             wire = tm.training_stats()["wire"]
             extras["wire_ratio"] = wire["compression_ratio"]

@@ -35,14 +35,16 @@ def _free_port():
     return p
 
 
-def _launch(nprocs, steps, out_dir, extra=()):
+def _launch(nprocs, steps, out_dir, extra=(), env_extra=None):
     port = _free_port()
+    env = _worker_env()
+    env.update(env_extra or {})
     procs = []
     for pid in range(nprocs):
         procs.append(subprocess.Popen(
             [sys.executable, HELPER, str(pid), str(nprocs), str(port),
              str(steps), out_dir, *extra],
-            env=_worker_env(), stdout=subprocess.PIPE,
+            env=env, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True))
     outs = []
     for p in procs:
@@ -266,6 +268,37 @@ def test_two_process_compressed_local_sgd(tmp_path):
     assert np.isfinite(float(data["score"]))
     assert int(data["wire_rendezvous"]) == 2
     assert 0.0 < float(data["wire_ratio"]) < 1.0
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_two_process_supervised_worker_kill_midstep(tmp_path_factory):
+    """ROADMAP gap closed: a REAL 2-process `jax.distributed` job is
+    killed mid-step via the `train.step` fault point (armed identically
+    on both workers through DL4J_TPU_FAULTS — the whole slice dies, the
+    deterministic analogue of a TPU worker loss); each worker's
+    in-process Supervisor catches the crash, restores the newest valid
+    checkpoint, and resumes. Final params must match an uninterrupted
+    2-process run exactly."""
+    steps = 6
+    ref_dir = str(tmp_path_factory.mktemp("chaos_ref"))
+    _launch(2, steps, ref_dir, ("--checkpoint-every", "1"))
+    ref = np.load(os.path.join(ref_dir, "final_params.npz"))
+
+    out = str(tmp_path_factory.mktemp("chaos_kill"))
+    outs = _launch(
+        2, steps, out, ("--checkpoint-every", "1", "--supervise", "2"),
+        env_extra={"DL4J_TPU_FAULTS": "train.step:raise@4"})
+    assert all("done" in o for o in outs), outs
+    data = np.load(os.path.join(out, "final_params.npz"))
+    assert int(data["restarts"]) == 1   # exactly one supervised resume
+    got = [data[k] for k in data.files if k.startswith("arr_")]
+    refp = [ref[k] for k in ref.files if k.startswith("arr_")]
+    assert len(got) == len(refp)
+    for g, e in zip(got, refp):
+        # checkpoint resume replays the identical data/rng stream
+        np.testing.assert_allclose(g, e, rtol=1e-6, atol=1e-7)
+    assert int(data["iteration"]) == steps
 
 
 def test_orbax_checkpoint_resume(tmp_path):

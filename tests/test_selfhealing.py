@@ -1,0 +1,624 @@
+"""Self-healing training tests (PR 3 tentpole): NonFiniteGuard
+policies (skip_step / rollback / abort), StepWatchdog hang escalation,
+preemption checkpoint-then-exit (signal + `train.preempt` fault),
+bounded-restart Supervisor, flaky-data (`data.next`) policies, the
+all-points chaos proof, orbax tree-manifest integrity parity, the
+fault-point registry pin, and ParallelInference `warmup_inputs`."""
+
+import os
+import re
+import signal
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel.training_master import TrainingMaster
+from deeplearning4j_tpu.resilience import (
+    REGISTERED_POINTS,
+    FaultInjectedError,
+    NonFiniteGuard,
+    NonFiniteLossError,
+    PreemptedError,
+    Retry,
+    StepWatchdog,
+    Supervisor,
+    injector,
+)
+
+N_IN, N_OUT, ROWS = 4, 3, 16
+
+
+def _net(seed=7):
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    conf = (NeuralNetConfiguration.Builder().seed(seed).updater("adam")
+            .learning_rate(1e-2).activation("tanh").weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=N_OUT, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(N_IN))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _batch(step):
+    rng = np.random.default_rng(500 + step)
+    x = rng.normal(size=(ROWS, N_IN)).astype(np.float32)
+    y = np.eye(N_OUT, dtype=np.float32)[rng.integers(0, N_OUT, ROWS)]
+    return x, y
+
+
+def _params(net):
+    import jax
+
+    return [np.asarray(TrainingMaster._host_leaf(l))
+            for l in jax.tree_util.tree_leaves(net.params)]
+
+
+def _upd(net):
+    import jax
+
+    return [np.asarray(TrainingMaster._host_leaf(l))
+            for l in jax.tree_util.tree_leaves(net.updater_states)]
+
+
+def _oracle(batch_ids, seed=7):
+    """Serial TrainingMaster run over exactly `batch_ids` (the
+    determinism oracle for skip/rollback: a poisoned batch skipped by
+    the guard must equal a run that never saw it)."""
+    net = _net(seed)
+    order = list(batch_ids)
+    TrainingMaster(net).fit(lambda s: _batch(order[s]), len(order))
+    return net
+
+
+def _assert_same_params(net_a, net_b):
+    for a, b in zip(_params(net_a), _params(net_b)):
+        np.testing.assert_array_equal(a, b)
+
+
+def _assert_checkpoints_finite(tm, ckpt_dir):
+    for step in tm.list_checkpoints():
+        path = os.path.join(ckpt_dir, f"step-{step:08d}.npz")
+        with np.load(path) as data:
+            for k in data.files:
+                arr = data[k]
+                if arr.dtype.kind == "f":
+                    assert np.isfinite(arr).all(), \
+                        f"checkpoint step {step} key {k} is non-finite"
+
+
+# ================================================= NonFiniteGuard
+@pytest.mark.chaos
+def test_guard_skip_step_leaves_state_byte_identical():
+    """Acceptance pin: a NaN-injected step under policy='skip_step'
+    leaves params, updater state, rng, and counters byte-identical to
+    the pre-step state."""
+    net = _net()
+    g = NonFiniteGuard(policy="skip_step", check_every=1)
+    tm = TrainingMaster(net, guard=g)
+    tm.fit(lambda s: _batch(s), 2)
+    pre_p, pre_u = _params(net), _upd(net)
+    pre_it, pre_rng = net.iteration, np.asarray(net._rng).copy()
+
+    injector().inject("train.grad_nonfinite", at_hit=1)
+    tm.fit(lambda s: _batch(s), 3, start_step=2)
+
+    assert g.counters["checks"] >= 1
+    assert g.counters["nonfinite"] == 1
+    assert g.counters["skipped_steps"] == 1
+    assert net.iteration == pre_it
+    np.testing.assert_array_equal(np.asarray(net._rng), pre_rng)
+    for a, b in zip(pre_u, _upd(net)):
+        assert a.tobytes() == b.tobytes()
+    for a, b in zip(pre_p, _params(net)):
+        assert a.tobytes() == b.tobytes()
+
+
+@pytest.mark.chaos
+def test_guard_skip_matches_run_without_poisoned_batch():
+    net = _net()
+    g = NonFiniteGuard(policy="skip_step", check_every=1)
+    tm = TrainingMaster(net, guard=g)
+    injector().inject("train.grad_nonfinite", at_hit=4)   # poison step 3
+    tm.fit(lambda s: _batch(s), 6)
+    assert g.counters["skipped_steps"] == 1
+    assert net.iteration == 5
+    _assert_same_params(net, _oracle([0, 1, 2, 4, 5]))
+
+
+@pytest.mark.chaos
+def test_guard_rollback_restores_checkpoint_and_skips_window(tmp_path):
+    net = _net()
+    g = NonFiniteGuard(policy="rollback", check_every=1)
+    tm = TrainingMaster(net, checkpoint_dir=str(tmp_path),
+                        checkpoint_every=1, guard=g)
+    injector().inject("train.grad_nonfinite", at_hit=4)   # poison step 3
+    tm.fit(lambda s: _batch(s), 6)
+    assert g.counters["rollbacks"] == 1
+    assert tm._poisoned_steps == {3}
+    # the replay after rollback skipped the poisoned window, so the run
+    # equals one that never saw batch 3 — and no checkpoint is ever
+    # published with non-finite state
+    _assert_same_params(net, _oracle([0, 1, 2, 4, 5]))
+    _assert_checkpoints_finite(tm, str(tmp_path))
+
+
+def test_guard_rollback_requires_checkpoint_dir():
+    with pytest.raises(ValueError):
+        TrainingMaster(_net(), guard=NonFiniteGuard(policy="rollback"))
+
+
+@pytest.mark.chaos
+def test_guard_abort_raises():
+    net = _net()
+    tm = TrainingMaster(net, guard=NonFiniteGuard(policy="abort",
+                                                  check_every=1))
+    injector().inject("train.grad_nonfinite", at_hit=2)
+    with pytest.raises(NonFiniteLossError):
+        tm.fit(lambda s: _batch(s), 4)
+
+
+@pytest.mark.chaos
+def test_checkpoints_never_publish_nonfinite_state(tmp_path):
+    """With sampled checking (check_every=3), a poison landing on an
+    UNCHECKED step is still caught by the forced pre-checkpoint check —
+    torn/NaN state must never be published."""
+    net = _net()
+    g = NonFiniteGuard(policy="rollback", check_every=3)
+    tm = TrainingMaster(net, checkpoint_dir=str(tmp_path),
+                        checkpoint_every=1, guard=g)
+    injector().inject("train.grad_nonfinite", at_hit=2)   # step 1
+    tm.fit(lambda s: _batch(s), 4)
+    assert g.counters["nonfinite"] == 1
+    assert tm._poisoned_steps == {1}
+    _assert_checkpoints_finite(tm, str(tmp_path))
+    _assert_same_params(net, _oracle([0, 2, 3]))
+
+
+def test_guard_loss_spike_detection():
+    """A finite but spiking loss is flagged once the EMA is seeded."""
+    g = NonFiniteGuard(policy="skip_step", check_every=1,
+                       loss_spike_factor=3.0)
+
+    class _FakeNet:
+        params = {}
+        updater_states = {}
+
+    import jax.numpy as jnp
+
+    net = _FakeNet()
+    net._score = jnp.asarray(1.0)
+    assert g.post_step(net) == "ok"          # seeds the EMA
+    net._score = jnp.asarray(100.0)
+    assert g.post_step(net) == "spike"
+    net._score = jnp.asarray(float("nan"))
+    assert g.post_step(net) == "nonfinite"
+    assert g.counters["spikes"] == 1 and g.counters["nonfinite"] == 1
+
+
+# ================================================= watchdog + supervisor
+@pytest.mark.chaos
+def test_watchdog_escalates_hang_and_supervisor_resumes(tmp_path):
+    """A wedged step (train.hang delay) is detected by the watchdog
+    within its timeout and escalated as a restartable StepHangError;
+    the Supervisor resumes from the newest checkpoint and the final
+    params match an un-faulted run exactly."""
+    net = _net()
+    wd = StepWatchdog(timeout_s=4.0, poll_s=0.1)
+    tm = TrainingMaster(net, checkpoint_dir=str(tmp_path),
+                        checkpoint_every=1, watchdog=wd)
+    injector().inject("train.hang", mode="delay", at_hit=3,
+                      delay_s=30.0)
+    sup = Supervisor(max_restarts=2, initial_backoff_s=0.05)
+    sup.run(tm.fit, lambda s: _batch(s), 4)
+    assert wd.counters["hangs_detected"] == 1
+    assert [e["error_class"] for e in sup.restart_ledger] \
+        == ["StepHangError"]
+    _assert_same_params(net, _oracle([0, 1, 2, 3]))
+
+
+def test_supervisor_gives_up_after_max_restarts():
+    from deeplearning4j_tpu.resilience import RestartsExhaustedError
+
+    calls = {"n": 0}
+
+    def always_crashes():
+        calls["n"] += 1
+        raise RuntimeError("boom")
+
+    sup = Supervisor(max_restarts=2, initial_backoff_s=0.0,
+                     sleep=lambda s: None)
+    with pytest.raises(RestartsExhaustedError) as ei:
+        sup.run(always_crashes)
+    assert calls["n"] == 3                     # initial + 2 restarts
+    assert len(ei.value.ledger) == 3
+    assert ei.value.ledger[-1].get("gave_up") is True
+
+
+def test_supervisor_does_not_restart_abort_verdicts():
+    calls = {"n": 0}
+
+    def aborts():
+        calls["n"] += 1
+        raise NonFiniteLossError("policy=abort")
+
+    sup = Supervisor(max_restarts=3, sleep=lambda s: None)
+    with pytest.raises(NonFiniteLossError):
+        sup.run(aborts)
+    assert calls["n"] == 1 and sup.restart_ledger == []
+
+
+# ================================================= preemption
+@pytest.mark.chaos
+def test_preemption_fault_checkpoints_and_resumes(tmp_path):
+    """The `train.preempt` fault simulates a TPU preemption: the loop
+    checkpoints the current state and raises PreemptedError; a
+    supervised run resumes to the same result as an un-faulted one."""
+    net = _net()
+    tm = TrainingMaster(net, checkpoint_dir=str(tmp_path),
+                        checkpoint_every=2, preemption=True)
+    injector().inject("train.preempt", at_hit=4)   # boundary of step 3
+    sup = Supervisor(max_restarts=1, initial_backoff_s=0.05)
+    sup.run(tm.fit, lambda s: _batch(s), 6)
+    assert tm._resil_counters["preemptions"] == 1
+    assert 3 in tm.list_checkpoints()     # the preemption checkpoint
+    assert [e["error_class"] for e in sup.restart_ledger] \
+        == ["PreemptedError"]
+    _assert_same_params(net, _oracle([0, 1, 2, 3, 4, 5]))
+
+
+@pytest.mark.chaos
+def test_sigterm_checkpoints_then_exits_and_resume_matches(tmp_path):
+    """A real SIGTERM mid-fit: the handler defers to the next step
+    boundary, which checkpoints and raises PreemptedError — zero
+    completed steps lost; a relaunch resumes to the uninterrupted
+    result."""
+    net = _net()
+
+    class KillAt:
+        def iteration_done(self, n, iteration):
+            if iteration == 2:
+                os.kill(os.getpid(), signal.SIGTERM)
+
+    net.listeners.append(KillAt())
+    tm = TrainingMaster(net, checkpoint_dir=str(tmp_path),
+                        checkpoint_every=10, preemption=True)
+    with pytest.raises(PreemptedError) as ei:
+        tm.fit(lambda s: _batch(s), 6)
+    assert ei.value.step == 2
+    assert tm.list_checkpoints() == [2]
+
+    net2 = _net()
+    tm2 = TrainingMaster(net2, checkpoint_dir=str(tmp_path),
+                         checkpoint_every=10, preemption=True)
+    tm2.fit(lambda s: _batch(s), 6)
+    _assert_same_params(net2, _oracle([0, 1, 2, 3, 4, 5]))
+
+
+# ================================================= flaky data iterator
+@pytest.mark.chaos
+def test_data_next_transient_fault_is_retried():
+    net = _net()
+    retry = Retry(max_attempts=3, initial_backoff_s=0.01,
+                  retryable=lambda e: isinstance(e, FaultInjectedError))
+    tm = TrainingMaster(net, data_retry=retry)
+    injector().inject("data.next", at_hit=2)   # step 1, first attempt
+    tm.fit(lambda s: _batch(s), 4)
+    assert net.iteration == 4                  # no step lost
+    assert injector().hits("data.next") == 5   # 4 fetches + 1 retry
+    _assert_same_params(net, _oracle([0, 1, 2, 3]))
+
+
+@pytest.mark.chaos
+def test_data_fault_exhaustion_skips_step_without_corruption():
+    """Satellite: a persistently failing batch is skipped per policy
+    without corrupting step counters or updater state — the run equals
+    one that never saw the bad batch."""
+    net = _net()
+    retry = Retry(max_attempts=2, initial_backoff_s=0.01,
+                  retryable=lambda e: isinstance(e, FaultInjectedError))
+    tm = TrainingMaster(net, data_retry=retry, skip_bad_batches=True)
+    # hits 2+3 = both attempts of step 1 (exhausted -> skipped);
+    # hit 4 = step 2's first attempt (retried ok on hit 5)
+    injector().inject("data.next", at_hit=2, times=3)
+    tm.fit(lambda s: _batch(s), 4)
+    assert tm._resil_counters["data_skipped_steps"] == 1
+    assert net.iteration == 3
+    _assert_same_params(net, _oracle([0, 2, 3]))
+
+
+# ================================================= the chaos proof
+@pytest.mark.chaos
+def test_chaos_all_training_fault_points_supervised(tmp_path):
+    """Acceptance proof: with faults armed at ALL of train.step,
+    data.next, train.grad_nonfinite, train.hang, and train.preempt, a
+    supervised TrainingMaster.fit completes, never publishes a torn or
+    non-finite checkpoint, and the final params exactly match an
+    un-faulted run over the surviving (non-poisoned) data stream."""
+    net = _net()
+    g = NonFiniteGuard(policy="rollback", check_every=1)
+    wd = StepWatchdog(timeout_s=4.0, poll_s=0.1)
+    retry = Retry(max_attempts=3, initial_backoff_s=0.01,
+                  retryable=lambda e: isinstance(e, FaultInjectedError))
+    sup = Supervisor(max_restarts=4, initial_backoff_s=0.05)
+    tm = TrainingMaster(net, checkpoint_dir=str(tmp_path),
+                        checkpoint_every=1, guard=g, watchdog=wd,
+                        preemption=True, data_retry=retry,
+                        supervisor=sup)
+    injector().load_spec_string(
+        "train.step:raise@2,"            # worker-loss crash
+        "data.next:raise@8,"             # flaky iterator (retried)
+        "train.grad_nonfinite:raise@5,"  # NaN batch (rolled back)
+        "train.hang:delay@7~30.0,"       # wedged step (watchdog)
+        "train.preempt:raise@9")         # simulated TPU preemption
+    sup.run(tm.fit, lambda s: _batch(s), 8)
+
+    classes = [e["error_class"] for e in sup.restart_ledger]
+    assert classes == ["FaultInjectedError", "StepHangError",
+                       "PreemptedError"]
+    assert g.counters["rollbacks"] == 1 and tm._poisoned_steps == {4}
+    assert wd.counters["hangs_detected"] == 1
+    assert tm._resil_counters["preemptions"] == 1
+    assert injector().hits("data.next") > injector().hits("train.step") \
+        - 2  # sanity: every point actually fired
+    _assert_checkpoints_finite(tm, str(tmp_path))
+    _assert_same_params(
+        net, _oracle([s for s in range(8)
+                      if s not in tm._poisoned_steps]))
+
+    stats = tm.training_stats()["resilience"]
+    assert stats["supervisor"]["restarts"] == 3
+    assert stats["guard"]["rollbacks"] == 1
+    assert stats["watchdog"]["hangs_detected"] == 1
+    assert stats["counters"]["grad_poisoned_steps"] == 1
+    assert stats["poisoned_steps"] == [4]
+
+
+# ================================================= wrapper + earlystopping
+@pytest.mark.chaos
+def test_parallel_wrapper_guard_skips_nan_batch():
+    """A batch containing real NaN features is skipped by the wrapper's
+    guard; the result equals a fit that never saw it."""
+    import jax
+
+    from deeplearning4j_tpu.parallel import ParallelWrapper, make_mesh
+
+    devices = jax.devices("cpu")[:4]
+    batches = [_batch(s) for s in range(5)]
+    bad = (np.full_like(batches[2][0], np.nan), batches[2][1])
+    poisoned = batches[:2] + [bad] + batches[3:]
+
+    g = NonFiniteGuard(policy="skip_step", check_every=1)
+    net = _net()
+    ParallelWrapper(net, mesh=make_mesh(dp=4, devices=devices),
+                    guard=g).fit(poisoned)
+    assert g.counters["skipped_steps"] == 1
+
+    clean_net = _net()
+    ParallelWrapper(clean_net,
+                    mesh=make_mesh(dp=4, devices=devices)).fit(
+                        batches[:2] + batches[3:])
+    _assert_same_params(net, clean_net)
+
+
+def test_parallel_wrapper_rejects_rollback_guard():
+    from deeplearning4j_tpu.parallel import ParallelWrapper
+
+    with pytest.raises(ValueError):
+        ParallelWrapper(_net(), workers=2,
+                        guard=NonFiniteGuard(policy="rollback"))
+
+
+@pytest.mark.chaos
+def test_earlystopping_guard_skips_nonfinite_batch():
+    from deeplearning4j_tpu.earlystopping import (
+        EarlyStoppingConfiguration,
+        EarlyStoppingTrainer,
+        MaxEpochsTerminationCondition,
+    )
+    from deeplearning4j_tpu.earlystopping.saver import InMemoryModelSaver
+
+    batches = [_batch(s) for s in range(4)]
+    bad = (np.full_like(batches[1][0], np.nan), batches[1][1])
+    data = batches[:1] + [bad] + batches[2:]
+
+    g = NonFiniteGuard(policy="skip_step", check_every=1)
+    cfg = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(2)],
+        model_saver=InMemoryModelSaver(), evaluate_every_n_epochs=1)
+    result = EarlyStoppingTrainer(cfg, _net(), data, guard=g).fit()
+    assert g.counters["skipped_steps"] >= 1
+    assert np.isfinite(result.best_model_score)
+
+
+# ================================================= fault-point registry
+def test_fault_point_registry_matches_source_and_tests():
+    """Satellite: every fire(...) site in the package uses a registered
+    name, every registered name has a fire site, and every registered
+    point is exercised (named) by at least one test — so a new fault
+    point cannot land silently untested."""
+    import pathlib
+
+    import deeplearning4j_tpu
+
+    pkg = pathlib.Path(deeplearning4j_tpu.__file__).parent
+    fired = set()
+    for p in pkg.rglob("*.py"):
+        fired |= set(re.findall(r'fire\(\s*"([a-z_.]+)"', p.read_text()))
+    assert fired == set(REGISTERED_POINTS), (
+        f"source fire() sites and REGISTERED_POINTS disagree: "
+        f"only-in-source={sorted(fired - REGISTERED_POINTS)} "
+        f"only-in-registry={sorted(REGISTERED_POINTS - fired)}")
+
+    tests_dir = pathlib.Path(__file__).parent
+    blob = "\n".join(p.read_text() for p in tests_dir.rglob("*.py"))
+    untested = sorted(pt for pt in REGISTERED_POINTS if pt not in blob)
+    assert not untested, f"fault points with no test naming them: " \
+                         f"{untested}"
+
+
+# ================================================= orbax manifest parity
+@pytest.mark.chaos
+def test_orbax_manifest_detects_torn_directory(tmp_path):
+    """Satellite (ROADMAP gap): step-N.orbax directories get a sha256
+    tree manifest at save; a torn file inside the newest dir fails
+    verification and the fallback scan resumes from the older one —
+    npz-parity for the orbax format."""
+    pytest.importorskip("orbax.checkpoint")
+    net = _net()
+    tm = TrainingMaster(net, checkpoint_dir=str(tmp_path),
+                        checkpoint_every=1, checkpoint_format="orbax")
+    tm.fit(lambda s: _batch(s), 3)
+    newest = tmp_path / "step-3.orbax"
+    assert (newest / "manifest.sha256.json").exists()
+
+    victims = [p for p in newest.rglob("*")
+               if p.is_file() and p.name != "manifest.sha256.json"
+               and p.stat().st_size > 0]
+    big = max(victims, key=lambda p: p.stat().st_size)
+    big.write_bytes(big.read_bytes()[:big.stat().st_size // 2])
+
+    net2 = _net()
+    tm2 = TrainingMaster(net2, checkpoint_dir=str(tmp_path),
+                         checkpoint_every=1, checkpoint_format="orbax")
+    assert tm2.load_latest_checkpoint() == 2
+    for leaf in _params(net2):
+        assert np.isfinite(leaf).all()
+
+
+def test_tree_manifest_roundtrip(tmp_path):
+    from deeplearning4j_tpu.resilience import (
+        validate_tree,
+        write_tree_manifest,
+    )
+
+    d = tmp_path / "ck"
+    (d / "sub").mkdir(parents=True)
+    (d / "a.bin").write_bytes(b"hello")
+    (d / "sub" / "b.bin").write_bytes(b"world")
+    entries = write_tree_manifest(str(d))
+    assert set(entries) == {"a.bin", os.path.join("sub", "b.bin")}
+    assert validate_tree(str(d))
+    (d / "a.bin").write_bytes(b"hell")          # torn
+    assert not validate_tree(str(d))
+    # a dir with no manifest passes (pre-parity checkpoints)
+    e = tmp_path / "plain"
+    e.mkdir()
+    (e / "x").write_bytes(b"x")
+    assert validate_tree(str(e))
+
+
+# ================================================= warmup_inputs satellite
+def _two_input_graph():
+    from deeplearning4j_tpu import ComputationGraph, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(9).updater("sgd").learning_rate(0.1)
+            .activation("tanh").weight_init("xavier")
+            .graph_builder()
+            .add_inputs("a", "b")
+            .add_layer("da", DenseLayer(n_out=6), "a")
+            .add_layer("db", DenseLayer(n_out=6), "b")
+            .add_layer("out", OutputLayer(n_out=2, loss="mcxent"),
+                       "da", "db")
+            .set_outputs("out")
+            .set_input_types(a=InputType.feed_forward(4),
+                             b=InputType.feed_forward(3))
+            .build())
+    return ComputationGraph(conf).init()
+
+
+def test_warmup_inputs_enable_multi_input_graph_warmup():
+    """Satellite (ROADMAP gap): multi-input ComputationGraphs can't
+    derive a warmup shape from the conf — explicit `warmup_inputs`
+    pre-traces every bucket instead of silently skipping."""
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+    net = _two_input_graph()
+    pi = ParallelInference(net, batch_limit=4,
+                           warmup_inputs=[(4,), (3,)])
+    try:
+        assert pi._warmed_buckets == [1, 2, 4]
+        assert pi.stats()["warmed_buckets"] == [1, 2, 4]
+        assert pi.trace_stats()["total_traces"] >= 1
+    finally:
+        pi.shutdown()
+
+    # example arrays (leading batch dim) work too
+    net2 = _two_input_graph()
+    pi2 = ParallelInference(
+        net2, batch_limit=2,
+        warmup_inputs=[np.zeros((1, 4), np.float32),
+                       np.zeros((1, 3), np.float32)])
+    try:
+        assert pi2._warmed_buckets == [1, 2]
+    finally:
+        pi2.shutdown()
+
+
+def test_warmup_skip_warns_once(caplog):
+    import logging
+
+    from deeplearning4j_tpu.parallel import inference as inf_mod
+
+    net = _two_input_graph()
+    inf_mod._WARMUP_SKIP_WARNED = False
+    with caplog.at_level(logging.WARNING, logger="deeplearning4j_tpu"):
+        pi = inf_mod.ParallelInference(net, batch_limit=4)
+        try:
+            assert pi._warmed_buckets == []
+        finally:
+            pi.shutdown()
+        # second construction: no second warning
+        n_before = sum("warmup skipped" in r.message
+                       for r in caplog.records)
+        pi2 = inf_mod.ParallelInference(net, batch_limit=4)
+        pi2.shutdown()
+    assert n_before == 1
+    assert sum("warmup skipped" in r.message
+               for r in caplog.records) == 1
+
+
+# ================================================= stats surfacing
+def test_training_stats_surface_resilience_counters(tmp_path):
+    net = _net()
+    g = NonFiniteGuard(policy="skip_step", check_every=1)
+    tm = TrainingMaster(net, guard=g,
+                        watchdog=StepWatchdog(timeout_s=60.0))
+    tm.fit(lambda s: _batch(s), 2, collect_training_stats=True)
+    stats = tm.training_stats()
+    resil = stats["resilience"]
+    assert resil["guard"]["checks"] == 2
+    assert resil["guard"]["policy"] == "skip_step"
+    assert resil["watchdog"]["beats"] > 0
+    out = str(tmp_path / "timeline.html")
+    tm.export_stats_html(out)
+    content = open(out).read()
+    assert "resilience" in content and "skip_step" in content
+
+    # plain runs (no hooks) keep the old contract: resilience is None
+    net2 = _net()
+    tm2 = TrainingMaster(net2)
+    tm2.fit(lambda s: _batch(s), 1)
+    assert tm2.training_stats()["resilience"] is None
+
+
+def test_dashboard_renders_resilience_line(tmp_path):
+    from deeplearning4j_tpu.stats.dashboard import render_html
+    from deeplearning4j_tpu.stats.listener import StatsListener
+    from deeplearning4j_tpu.stats.storage import InMemoryStatsStorage
+
+    net = _net()
+    storage = InMemoryStatsStorage()
+    net.listeners.append(StatsListener(storage, frequency=1,
+                                       session_id="s"))
+    g = NonFiniteGuard(policy="skip_step", check_every=1)
+    tm = TrainingMaster(net, guard=g)
+    tm.fit(lambda s: _batch(s), 2)
+    page = render_html(storage, resilience=tm.resilience_stats())
+    assert "DATA.resilience" in page and '"policy": "skip_step"' in page
