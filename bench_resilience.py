@@ -5,6 +5,11 @@ the happy path).
 Measures TrainingMaster.fit steps/sec on a CPU MLP under:
   baseline        no self-healing hooks
   watchdog        StepWatchdog attached (beats only — no hang)
+  watchdog_hb     StepWatchdog + cluster HeartbeatFile lease (PR 4:
+                  the beat path additionally renews an atomic mtime
+                  lease, throttled to one json write + rename per
+                  0.2s — the per-step cost the ClusterSupervisor adds
+                  to a supervised worker)
   guard_abort_N   NonFiniteGuard(policy='abort', check_every=N)
                   (pure check cost: one jitted all-finite reduction +
                   host bool fetch per checked step, no snapshot)
@@ -18,6 +23,7 @@ training" section).
 """
 
 import json
+import os
 import sys
 import time
 
@@ -56,6 +62,14 @@ def main():
     configs = [("baseline", {})]
     configs.append(("watchdog",
                     {"watchdog": StepWatchdog(timeout_s=300.0)}))
+    import tempfile
+
+    from deeplearning4j_tpu.resilience.cluster import HeartbeatFile
+
+    hb_path = os.path.join(tempfile.mkdtemp(prefix="bench_hb_"),
+                           "worker-0.hb.json")
+    configs.append(("watchdog_hb", {"watchdog": StepWatchdog(
+        timeout_s=300.0, heartbeat=HeartbeatFile(hb_path))}))
     for n in (1, 4, 16):
         configs.append((f"guard_abort_{n}", {"guard": NonFiniteGuard(
             policy="abort", check_every=n)}))

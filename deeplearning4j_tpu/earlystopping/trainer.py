@@ -16,17 +16,30 @@ logger = logging.getLogger("deeplearning4j_tpu")
 
 
 class EarlyStoppingTrainer:
-    def __init__(self, config, net, train_iterator, guard=None):
+    def __init__(self, config, net, train_iterator, guard=None,
+                 snapshot_every: int = 0):
         """`guard` (resilience.NonFiniteGuard) checks the net after
         (sampled) training batches: a non-finite/spiking batch is
         skipped with the pre-batch state restored (policy='skip_step')
-        or aborts the fit (policy='abort'); 'rollback' needs
-        TrainingMaster checkpoints and is rejected here."""
+        or aborts the fit (policy='abort'). 'rollback' needs a
+        rollback target: pass `snapshot_every=N` and an in-memory
+        device snapshot (resilience.PeriodicSnapshotter) refreshed
+        every N guarded batches is restored instead — no checkpoint
+        directory required."""
+        self._snapshotter = None
         if guard is not None and guard.policy == "rollback":
-            raise ValueError(
-                "NonFiniteGuard(policy='rollback') needs TrainingMaster "
-                "checkpoints; EarlyStoppingTrainer supports "
-                "skip_step/abort")
+            if snapshot_every <= 0:
+                raise ValueError(
+                    "NonFiniteGuard(policy='rollback') under "
+                    "EarlyStoppingTrainer needs snapshot_every=N > 0 "
+                    "(an in-memory rollback target; TrainingMaster "
+                    "uses checkpoints instead)")
+            from deeplearning4j_tpu.resilience.supervisor import (
+                PeriodicSnapshotter,
+            )
+
+            self._snapshotter = PeriodicSnapshotter(
+                guard, every=snapshot_every)
         self.config = config
         self.net = net
         self.train_iterator = train_iterator
@@ -52,6 +65,8 @@ class EarlyStoppingTrainer:
             return True
         check = g.should_check(self._guard_batches)
         self._guard_batches += 1
+        if self._snapshotter is not None:
+            self._snapshotter.maybe_snapshot(self.net)
         snap = (g.snapshot(self.net)
                 if check and g.policy == "skip_step" else None)
         self._fit_batch(batch)
@@ -66,6 +81,17 @@ class EarlyStoppingTrainer:
             logger.warning("early stopping: %s batch at epoch %d "
                            "skipped, state restored", verdict,
                            self.net.epoch)
+            return False
+        if g.policy == "rollback":
+            g.note_rollback()
+            if g.counters["rollbacks"] > g.max_rollbacks:
+                raise NonFiniteLossError(
+                    f"guard exceeded max_rollbacks={g.max_rollbacks} "
+                    f"at epoch {self.net.epoch}")
+            self._snapshotter.restore(self.net)
+            logger.warning("early stopping: %s batch at epoch %d — "
+                           "rolled back to in-memory snapshot",
+                           verdict, self.net.epoch)
             return False
         raise NonFiniteLossError(
             f"{verdict} training state at epoch {self.net.epoch} "

@@ -49,6 +49,7 @@ from deeplearning4j_tpu.resilience.supervisor import (
     PreemptionHandler,
     StepWatchdog,
     Supervisor,
+    fire_hang_hard,
 )
 
 logger = logging.getLogger("deeplearning4j_tpu")
@@ -75,7 +76,8 @@ class TrainingMaster:
                  preemption=False,
                  data_retry: Optional[Retry] = None,
                  skip_bad_batches: bool = False,
-                 supervisor: Optional[Supervisor] = None):
+                 supervisor: Optional[Supervisor] = None,
+                 guard_inner_steps: bool = False):
         """`averaging_frequency=k > 1` runs k-step local SGD between
         parameter rendezvous — each dp shard trains privately for k
         steps, then params (+ updater state) are averaged. This is the
@@ -131,6 +133,12 @@ class TrainingMaster:
         self.data_retry = data_retry
         self.skip_bad_batches = skip_bad_batches
         self.supervisor = supervisor
+        # local-SGD granularity fix (flag-gated — the default compiled
+        # program and cost profile are unchanged): the group program
+        # additionally returns per-inner-step losses so the guard can
+        # localize a poisoned INNER step instead of condemning the
+        # whole k-step window
+        self.guard_inner_steps = bool(guard_inner_steps)
         self._poisoned_steps = set()
         self._resil_counters = {"data_skipped_steps": 0,
                                 "grad_poisoned_steps": 0,
@@ -280,8 +288,9 @@ class TrainingMaster:
                     self._check_preemption(step)
                     _fire("train.step")
                     _fire("train.hang")
+                    fire_hang_hard()
                     if wd is not None:
-                        wd.beat("dispatch")
+                        wd.beat("dispatch", step=step)
                     t0 = time.perf_counter()
                     batch = self._next_batch(batch_fn, step)
                     if batch is None:       # bad batch skipped by policy
@@ -315,7 +324,7 @@ class TrainingMaster:
                     else:
                         net._train_step(x, y)
                     if wd is not None:
-                        wd.beat("fetch")
+                        wd.beat("fetch", step=step)
                     if check_now:
                         verdict = guard.post_step(net)
                         if verdict != "ok":
@@ -459,7 +468,8 @@ class TrainingMaster:
         if self._local_step is None:
             self._local_step = LocalStepTrainer(
                 net, self.mesh,
-                threshold=self.threshold_compression)
+                threshold=self.threshold_compression,
+                per_step_losses=self.guard_inner_steps)
         is_graph = hasattr(net.conf, "network_inputs")
         every = self.checkpoint_every
         with self.mesh:
@@ -468,17 +478,20 @@ class TrainingMaster:
                 self._check_preemption(step)
                 _fire("train.step")
                 _fire("train.hang")
+                fire_hang_hard()
                 if wd is not None:
-                    wd.beat("dispatch")
+                    wd.beat("dispatch", step=step)
                 t0 = time.perf_counter()
                 span = min(step + k, num_steps) - step
                 group = []
+                abs_steps = []     # group index -> global step
                 for s in range(step, step + span):
                     if s in self._poisoned_steps:
                         continue   # rollback replay: skip poisoned data
                     b = self._next_batch(batch_fn, s)
                     if b is not None:
                         group.append((self._maybe_poison(b[0]), b[1]))
+                        abs_steps.append(s)
                 if not group:
                     step += span
                     continue
@@ -499,7 +512,40 @@ class TrainingMaster:
                 else:
                     self._local_step.run_arrays(xs, ys)
                 if wd is not None:
-                    wd.beat("fetch")
+                    wd.beat("fetch", step=step)
+                if check_now and self.guard_inner_steps:
+                    # granularity fix: the compiled group program also
+                    # returned per-inner-step (dp-averaged) losses — a
+                    # non-finite one condemns THAT step only, not the
+                    # whole k-step window
+                    inner = np.asarray(
+                        self._local_step.last_step_losses)
+                    bad = [abs_steps[i] for i in range(len(abs_steps))
+                           if not np.isfinite(inner[i])]
+                    if bad:
+                        guard.counters["checks"] += 1
+                        guard.counters["nonfinite"] += 1
+                        if guard.policy == "abort":
+                            raise NonFiniteLossError(
+                                f"non-finite loss at inner step(s) "
+                                f"{bad} of group at step {step} "
+                                f"(policy=abort)")
+                        self._poisoned_steps.update(bad)
+                        if guard.policy == "skip_step":
+                            guard.restore(net, snap)
+                            guard.note_skip()
+                            logger.warning(
+                                "guard: non-finite inner step(s) %s — "
+                                "window replayed without them", bad)
+                        else:   # rollback
+                            guard.note_rollback()
+                            if guard.counters["rollbacks"] \
+                                    > guard.max_rollbacks:
+                                raise NonFiniteLossError(
+                                    "guard exceeded max_rollbacks="
+                                    f"{guard.max_rollbacks}")
+                            step = self.load_latest_checkpoint()
+                        continue   # re-enter the window minus `bad`
                 if check_now:
                     verdict = guard.post_step(net)
                     if verdict != "ok":
@@ -874,6 +920,34 @@ class TrainingMaster:
             # no valid npz: orbax dirs saved without (or with a torn)
             # latest pointer still count — retention/fallback parity
             return self._restore_newest_valid_orbax()
+        return self._restore_npz(step, meta)
+
+    def load_checkpoint_at(self, step: int) -> int:
+        """Resume handshake: restore EXACTLY `step` (validated),
+        raising on a missing/torn file instead of silently falling back
+        — the ClusterSupervisor relaunches every rank with one shared
+        resume step, and a rank whose filesystem view disagrees must
+        fail loudly (and be gang-restarted) rather than resume
+        elsewhere. step <= 0 means 'no checkpoint': start fresh."""
+        from deeplearning4j_tpu.resilience.errors import (
+            CheckpointIntegrityError,
+        )
+
+        if step <= 0:
+            self._stage_net()
+            return 0
+        if self.checkpoint_format == "orbax":
+            return self._load_orbax({"step": step})
+        path = self._ckpt_path(step)
+        fn = os.path.basename(path)
+        if not _ci.validate_file(self.checkpoint_dir or "", fn):
+            raise CheckpointIntegrityError(
+                f"resume handshake: checkpoint step {step} missing or "
+                f"failed validation in {self.checkpoint_dir}")
+        self._structural_ok(path)
+        return self._restore_npz(step, self._read_latest_meta())
+
+    def _restore_npz(self, step: int, meta) -> int:
         data = self._ckpt_retry.call(np.load, self._ckpt_path(step))
         import jax
 

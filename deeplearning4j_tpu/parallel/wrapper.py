@@ -75,21 +75,35 @@ class ParallelWrapper:
                  averaging_frequency: int = 1, average_updaters: bool = True,
                  mesh: Optional[Mesh] = None, prefetch_buffer: int = 2,
                  threshold_compression: float = 0.0,
-                 guard=None, watchdog=None):
+                 guard=None, watchdog=None, snapshot_every: int = 0):
         """`guard`/`watchdog` (resilience/supervisor.py) give fit() the
         same self-healing hooks as TrainingMaster: the NonFiniteGuard
         checks loss+params after (sampled) steps and skips or aborts on
-        non-finite state (`rollback` needs TrainingMaster checkpoints
-        and is rejected here); the StepWatchdog heartbeats per batch and
-        escalates a hung step/collective."""
+        non-finite state; the StepWatchdog heartbeats per batch and
+        escalates a hung step/collective. `rollback` policy needs a
+        rollback target: pass `snapshot_every=N` and an in-memory
+        device snapshot of the pre-step state is refreshed every N
+        guarded steps (resilience.PeriodicSnapshotter) — a poisoned
+        step rewinds to the newest snapshot, losing at most N-1 good
+        steps (no checkpoint directory required)."""
         self.net = net
         self.threshold_compression = float(threshold_compression)
         _require_local_sgd(averaging_frequency,
                            self.threshold_compression)
+        self._snapshotter = None
         if guard is not None and guard.policy == "rollback":
-            raise ValueError(
-                "NonFiniteGuard(policy='rollback') needs TrainingMaster "
-                "checkpoints; ParallelWrapper supports skip_step/abort")
+            if snapshot_every <= 0:
+                raise ValueError(
+                    "NonFiniteGuard(policy='rollback') under "
+                    "ParallelWrapper needs snapshot_every=N > 0 (an "
+                    "in-memory rollback target; TrainingMaster uses "
+                    "checkpoints instead)")
+            from deeplearning4j_tpu.resilience.supervisor import (
+                PeriodicSnapshotter,
+            )
+
+            self._snapshotter = PeriodicSnapshotter(
+                guard, every=snapshot_every)
         self.guard = guard
         self.watchdog = watchdog
         self._guard_steps = 0
@@ -144,8 +158,9 @@ class ParallelWrapper:
 
     def _run_guarded(self, thunk) -> bool:
         """Run one training step/group under the NonFiniteGuard; False
-        means the step was rejected and the pre-step state restored
-        (callers skip listeners for rejected steps)."""
+        means the step was rejected and the pre-step (skip_step) or
+        newest-snapshot (rollback) state restored (callers skip
+        listeners for rejected steps)."""
         from deeplearning4j_tpu.resilience.errors import (
             NonFiniteLossError,
         )
@@ -153,6 +168,8 @@ class ParallelWrapper:
         g = self.guard
         check = g is not None and g.should_check(self._guard_steps)
         self._guard_steps += 1
+        if self._snapshotter is not None:
+            self._snapshotter.maybe_snapshot(self.net)
         snap = (g.snapshot(self.net)
                 if check and g.policy == "skip_step" else None)
         thunk()
@@ -164,6 +181,14 @@ class ParallelWrapper:
         if g.policy == "skip_step":
             g.restore(self.net, snap)
             g.note_skip()
+            return False
+        if g.policy == "rollback":
+            g.note_rollback()
+            if g.counters["rollbacks"] > g.max_rollbacks:
+                raise NonFiniteLossError(
+                    f"guard exceeded max_rollbacks={g.max_rollbacks} "
+                    f"(last verdict {verdict})")
+            self._snapshotter.restore(self.net)
             return False
         raise NonFiniteLossError(
             f"{verdict} training state detected (policy=abort)")
@@ -407,7 +432,7 @@ class LocalStepTrainer:
     """
 
     def __init__(self, net, mesh: Mesh, average_updaters: bool = True,
-                 threshold: float = 0.0):
+                 threshold: float = 0.0, per_step_losses: bool = False):
         """`threshold > 0` enables threshold compression of the k-step
         parameter delta at each rendezvous (the reference's
         EncodingHandler.java:57-73 role, composed with local SGD): each
@@ -433,6 +458,12 @@ class LocalStepTrainer:
         self.mesh = mesh
         self.average_updaters = average_updaters
         self.threshold = float(threshold)
+        # per_step_losses=True compiles the group program to ALSO
+        # return the k dp-averaged inner-step losses (read back via
+        # `last_step_losses`) so a guard can localize a poisoned inner
+        # step; off by default — the compiled program is unchanged
+        self.per_step_losses = bool(per_step_losses)
+        self.last_step_losses = None
         self._fn_cache = {}
         self._residual = None
         self._sent_nnz = []          # per-rendezvous device scalars
@@ -507,20 +538,30 @@ class LocalStepTrainer:
             states = pmean(states)
             if avg_upd:
                 upd_states = pmean(upd_states)
-            return (params, upd_states, states,
-                    jax.lax.pmean(jnp.mean(losses), "dp"),
-                    residual, nnz)
+            out = (params, upd_states, states,
+                   jax.lax.pmean(jnp.mean(losses), "dp"),
+                   residual, nnz)
+            if step_losses:
+                # [k] dp-averaged inner-step losses: a NaN shard
+                # propagates through the pmean, so the host can point
+                # at the exact poisoned inner step
+                out += (jax.lax.pmean(losses, "dp"),)
+            return out
 
+        step_losses = self.per_step_losses
         rep = P()             # replicated at entry/exit
         xspec = P(None, "dp")  # [k, batch, ...]: batch dim sharded
         fspec = xspec if with_fm else rep
         lspec = xspec if with_lm else rep
         rspec = P("dp")       # per-shard residual, [dp, ...] outside
+        outs = (rep, rep, rep, rep, rspec, rep)
+        if step_losses:
+            outs += (rep,)
         return jax.jit(jax.shard_map(
             worker, mesh=self.mesh,
             in_specs=(rep, rep, rep, rspec, rep, xspec, xspec, fspec,
                       lspec, rep, rep),
-            out_specs=(rep, rep, rep, rep, rspec, rep),
+            out_specs=outs,
             check_vma=False),
             donate_argnums=(0, 1, 2, 3))
 
@@ -663,13 +704,18 @@ class LocalStepTrainer:
         net._rng, sub = jax.random.split(net._rng)
         if self._residual is None:
             self._residual = self._init_residual()
-        (net.params, net.updater_states, net.states, loss,
-         self._residual, nnz) = self._fn_cache[key](
+        out = self._fn_cache[key](
                 net.params, net.updater_states, net.states,
                 self._residual,
                 jnp.asarray(net.iteration, jnp.int32),
                 xs_in, ys_in, fms_in, lms_in, sub,
                 jnp.asarray(net._lr_score_factor, jnp.float32))
+        if self.per_step_losses:
+            (net.params, net.updater_states, net.states, loss,
+             self._residual, nnz, self.last_step_losses) = out
+        else:
+            (net.params, net.updater_states, net.states, loss,
+             self._residual, nnz) = out
         if self.threshold > 0.0:
             # keep per-rendezvous device scalars; summed (in f64-safe
             # host arithmetic) only when wire_stats() is read, so the

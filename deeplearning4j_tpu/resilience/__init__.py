@@ -54,9 +54,19 @@ from deeplearning4j_tpu.resilience.checkpoint_integrity import (
 )
 from deeplearning4j_tpu.resilience.supervisor import (
     NonFiniteGuard,
+    PeriodicSnapshotter,
     PreemptionHandler,
     StepWatchdog,
     Supervisor,
+    fire_hang_hard,
+)
+from deeplearning4j_tpu.resilience.cluster import (
+    EXIT_HANG,
+    EXIT_NAN,
+    ClusterSupervisor,
+    HeartbeatFile,
+    heartbeat_path,
+    reap_stray_workers,
 )
 
 __all__ = [
@@ -69,7 +79,10 @@ __all__ = [
     "FAULTS_ENV_VAR", "REGISTERED_POINTS", "FaultInjector", "FaultSpec",
     "fire", "injector",
     "CircuitBreaker", "Retry",
-    "NonFiniteGuard", "PreemptionHandler", "StepWatchdog", "Supervisor",
+    "NonFiniteGuard", "PeriodicSnapshotter", "PreemptionHandler",
+    "StepWatchdog", "Supervisor", "fire_hang_hard",
+    "EXIT_HANG", "EXIT_NAN", "ClusterSupervisor", "HeartbeatFile",
+    "heartbeat_path", "reap_stray_workers",
     "apply_retention", "atomic_write_bytes", "atomic_write_json",
     "atomic_writer", "list_all_checkpoints", "newest_valid_checkpoint",
     "record_checksum", "require_valid", "require_valid_tree",

@@ -1,0 +1,510 @@
+"""Cluster supervision: external gang relaunch for hard hangs.
+
+PR 3's in-process self-healing (resilience/supervisor.py) recovers
+everything a *live Python thread* can recover: crashes, preemptions,
+NaNs, and hangs interruptible by SIGUSR1. Its documented blind spot is
+a truly wedged native collective — the training thread never reaches a
+step boundary, the signal escalation is not delivered (or the wait is
+simply not signal-interruptible), and the job hangs forever. The
+reference DL4J stack delegates exactly this failure domain to an
+external driver (the Spark/parameter-server layer restarts dead
+executors); this module is that process-level half:
+
+  HeartbeatFile      the worker's liveness lease: an atomically-
+                     replaced JSON record {pid, step, phase, status,
+                     time} written from the StepWatchdog beat path
+                     (throttled — one write per `min_interval_s` at
+                     most, so the training loop never pays more than a
+                     small json dump + rename per interval).
+  ClusterSupervisor  spawns the worker processes themselves (one per
+                     jax.distributed rank, each in its own process
+                     group), monitors exit codes AND heartbeat leases,
+                     and on any fault performs a COHERENT GANG RESTART:
+                     kill every member (SIGTERM, grace, SIGKILL — a
+                     wedged native hang ignores SIGTERM; SIGKILL cannot
+                     be blocked), pick the newest valid checkpoint via
+                     the existing integrity scan, and relaunch all
+                     ranks with a fresh coordinator port and a SHARED
+                     resume step, so jax.distributed re-initializes
+                     cleanly and every rank restores the same state.
+
+Fault domains detected, in detection order:
+
+  crash              a member exited non-zero (incl. killed by signal)
+  hang (hard)        a member's lease went stale while the process is
+                     still alive — SIGUSR1-immune by construction; the
+                     supervisor SIGTERMs then SIGKILLs it. A member
+                     that exits with EXIT_HANG (the StepWatchdog's
+                     hard-exit escalation) is classified the same way.
+  nan abort          a member exited EXIT_NAN (NonFiniteLossError under
+                     policy='abort'); the gang restarts from the last
+                     checkpoint — before the poisoned step — bounded by
+                     the ledger like any other fault.
+
+Repeatedly failing members are QUARANTINED: each worker carries a
+restart budget (`max_restarts_per_worker`); the member that exhausts it
+is recorded and the whole gang aborts with RestartsExhaustedError
+carrying the full ledger — bounded recovery, never an indefinite hang.
+`max_gang_restarts` bounds the total independently.
+
+The `dist.heartbeat_stale` fault point fires at every lease check; an
+armed `raise` spec is consumed as a forced stale verdict, so the
+quarantine/kill path is drillable without real 60-second hangs.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import socket
+import subprocess
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from deeplearning4j_tpu.resilience import checkpoint_integrity as _ci
+from deeplearning4j_tpu.resilience.errors import (
+    DeadlineExceededError,
+    FaultInjectedError,
+    RestartsExhaustedError,
+)
+from deeplearning4j_tpu.resilience.faults import fire as _fire
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+# well-known worker exit codes (chosen clear of shell/signal ranges):
+# the StepWatchdog's hard-exit escalation and the worker's NaN-abort
+# wrapper use these so the supervisor can classify without parsing logs
+EXIT_HANG = 86   # os._exit by the watchdog: uninterruptible hang
+EXIT_NAN = 87    # NonFiniteLossError under policy='abort'
+
+# processes spawned by any ClusterSupervisor in this interpreter; the
+# test-suite teardown fixture sweeps it so a failing chaos test cannot
+# leak children into later tier-1 runs
+_LIVE_PROCS: List[subprocess.Popen] = []
+
+
+def heartbeat_path(directory: str, rank: int) -> str:
+    """The lease file for `rank` — one shared convention so the
+    supervisor and the worker derive the same path independently."""
+    return os.path.join(directory, f"worker-{rank}.hb.json")
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def reap_stray_workers() -> int:
+    """Kill the process group of every still-alive supervised worker
+    (test teardown hook). Returns how many were reaped."""
+    reaped = 0
+    for proc in list(_LIVE_PROCS):
+        if proc.poll() is None:
+            _kill_group(proc, signal.SIGKILL)
+            try:
+                proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                pass
+            reaped += 1
+        _LIVE_PROCS.remove(proc)
+    return reaped
+
+
+def _kill_group(proc: subprocess.Popen, sig) -> None:
+    """Signal the worker's whole process group (workers are spawned
+    with start_new_session=True, so pgid == pid and grandchildren die
+    with the member)."""
+    try:
+        os.killpg(proc.pid, sig)
+    except (ProcessLookupError, PermissionError, OSError):
+        try:
+            proc.send_signal(sig)
+        except (ProcessLookupError, OSError):
+            pass
+
+
+class HeartbeatFile:
+    """The worker side of the liveness lease.
+
+    `write()` atomically replaces the record (tmp + os.replace — no
+    fsync: heartbeats are advisory, a torn one just looks stale) and is
+    throttled to one disk write per `min_interval_s` unless the status
+    changes or `force=True`. The supervisor reads the file's mtime as
+    the lease timestamp, so a worker that stops calling write() —
+    wedged, killed, or swallowed by a native collective — goes stale
+    without any cooperation from the worker."""
+
+    def __init__(self, path: str, min_interval_s: float = 0.2):
+        self.path = path
+        self.min_interval_s = float(min_interval_s)
+        self.pid = os.getpid()
+        self.counters = {"writes": 0, "throttled": 0}
+        self._last_write = None
+        self._last_status = None
+        self._last = {"step": None, "phase": "init"}
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    def write(self, phase: str = "step", step: Optional[int] = None,
+              status: str = "running", force: bool = False) -> None:
+        now = time.monotonic()
+        if step is None:
+            step = self._last.get("step")
+        self._last = {"step": step, "phase": phase}
+        if (not force and status == self._last_status
+                and self._last_write is not None
+                and now - self._last_write < self.min_interval_s):
+            self.counters["throttled"] += 1
+            return
+        record = {"pid": self.pid, "step": step, "phase": phase,
+                  "status": status, "time": time.time()}
+        tmp = f"{self.path}.tmp.{self.pid}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(record, f)
+            os.replace(tmp, self.path)
+        except OSError:
+            # a full/flaky disk must not take down training: the lease
+            # goes stale and the SUPERVISOR decides, not an IOError here
+            logger.warning("heartbeat write failed: %s", self.path)
+            return
+        self._last_write = now
+        self._last_status = status
+        self.counters["writes"] += 1
+
+    def mark_hang(self, phase: str, age_s: float) -> None:
+        """The StepWatchdog's hard-exit marker: recorded BEFORE
+        os._exit so the supervisor can tell 'hang' from 'crash' even if
+        the exit code is lost (e.g. the process is later SIGKILLed)."""
+        self.write(phase=phase, status="hang", force=True)
+        logger.error("heartbeat %s marked hang (age %.1fs)",
+                     self.path, age_s)
+
+    def mark(self, status: str) -> None:
+        self.write(phase=self._last.get("phase") or "step",
+                   status=status, force=True)
+
+    @staticmethod
+    def read(path: str) -> Optional[dict]:
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    @staticmethod
+    def age_s(path: str) -> Optional[float]:
+        """Seconds since the lease was last renewed (None = no lease
+        yet). mtime-based, so even a torn/unparseable record counts as
+        a renewal — writes prove the process is alive."""
+        try:
+            return max(0.0, time.time() - os.path.getmtime(path))
+        except OSError:
+            return None
+
+
+class _Member:
+    """Supervisor-side view of one worker rank."""
+
+    def __init__(self, rank: int, hb_path: str):
+        self.rank = rank
+        self.hb_path = hb_path
+        self.proc: Optional[subprocess.Popen] = None
+        self.spawned_at = 0.0
+        self.restarts = 0
+        self.done = False
+
+    @property
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+
+class ClusterSupervisor:
+    """Spawn, lease-monitor, and gang-restart a jax.distributed worker
+    gang (the external-driver half of the fault-tolerance story; the
+    in-process half is resilience/supervisor.py).
+
+    `command_fn(rank, nprocs, port, resume_step) -> argv` builds each
+    member's command line; the supervisor allocates a fresh coordinator
+    `port` per generation (a relaunched jax.distributed gang must not
+    collide with the dead coordinator's socket) and passes the SHARED
+    `resume_step` (newest valid checkpoint at relaunch time, 0 when
+    none) so every rank restores the same state — the resume-step
+    handshake. `env_fn(rank)` may add per-rank environment (e.g. arm a
+    fault on one member only). Worker stdout/stderr go to
+    `<log_dir>/worker-<rank>.gen<G>.log`.
+
+    Liveness: a member is faulted when its process exits non-zero OR
+    its heartbeat lease (see HeartbeatFile) is older than
+    `lease_timeout_s` while the process is still alive; a member that
+    never heartbeats at all is given `startup_grace_s` (first beats
+    wait on interpreter + jax import + first-step compile). Any fault
+    triggers a coherent gang restart; per-member restarts are bounded
+    by `max_restarts_per_worker` (exceeded → the member is quarantined
+    and the gang aborts with RestartsExhaustedError), the total by
+    `max_gang_restarts`, and `run(timeout_s=...)` bounds wall time —
+    the supervisor can always be waited on, never hung on."""
+
+    def __init__(self, nprocs: int,
+                 command_fn: Callable[[int, int, int, int],
+                                      Sequence[str]],
+                 heartbeat_dir: str,
+                 checkpoint_dir: Optional[str] = None,
+                 lease_timeout_s: float = 30.0,
+                 startup_grace_s: float = 120.0,
+                 poll_s: float = 0.25,
+                 grace_s: float = 3.0,
+                 max_restarts_per_worker: int = 2,
+                 max_gang_restarts: int = 8,
+                 restart_backoff_s: float = 0.5,
+                 structural_check: Optional[Callable] = None,
+                 env: Optional[dict] = None,
+                 env_fn: Optional[Callable[[int], dict]] = None,
+                 log_dir: Optional[str] = None):
+        self.nprocs = int(nprocs)
+        self.command_fn = command_fn
+        self.heartbeat_dir = heartbeat_dir
+        self.checkpoint_dir = checkpoint_dir
+        self.lease_timeout_s = float(lease_timeout_s)
+        self.startup_grace_s = float(startup_grace_s)
+        self.poll_s = float(poll_s)
+        self.grace_s = float(grace_s)
+        self.max_restarts_per_worker = int(max_restarts_per_worker)
+        self.max_gang_restarts = int(max_gang_restarts)
+        self.restart_backoff_s = float(restart_backoff_s)
+        self.structural_check = structural_check
+        self.env = env
+        self.env_fn = env_fn
+        self.log_dir = log_dir or heartbeat_dir
+        os.makedirs(self.heartbeat_dir, exist_ok=True)
+        os.makedirs(self.log_dir, exist_ok=True)
+        self.members = [
+            _Member(r, heartbeat_path(heartbeat_dir, r))
+            for r in range(self.nprocs)]
+        self.generation = 0
+        self.gang_restarts = 0
+        self.quarantined: List[int] = []
+        self.restart_ledger: List[dict] = []
+        self.resume_steps: List[int] = []
+        self._t0 = time.monotonic()
+
+    # ------------------------------------------------------------ spawn
+    def _launch_gang(self, resume_step: int) -> None:
+        port = free_port()
+        for m in self.members:
+            # stale lease files from the previous generation must not
+            # trip the new one before its first beat
+            try:
+                os.remove(m.hb_path)
+            except OSError:
+                pass
+            m.done = False
+            argv = list(self.command_fn(m.rank, self.nprocs, port,
+                                        resume_step))
+            env = dict(self.env if self.env is not None else os.environ)
+            if self.env_fn is not None:
+                env.update(self.env_fn(m.rank) or {})
+            log = os.path.join(
+                self.log_dir,
+                f"worker-{m.rank}.gen{self.generation}.log")
+            with open(log, "ab") as logf:
+                m.proc = subprocess.Popen(
+                    argv, env=env, stdout=logf,
+                    stderr=subprocess.STDOUT,
+                    start_new_session=True)
+            m.spawned_at = time.monotonic()
+            _LIVE_PROCS.append(m.proc)
+        logger.info(
+            "cluster: launched gang generation %d (%d workers, port %d,"
+            " resume_step %d)", self.generation, self.nprocs, port,
+            resume_step)
+        self.generation += 1
+
+    # ------------------------------------------------------------- kill
+    def _kill_member(self, m: _Member) -> None:
+        """SIGTERM (a worker with a PreemptionHandler checkpoints and
+        exits cleanly), grace, then SIGKILL the process group — the
+        only signal a wedged native hang cannot ignore."""
+        if not m.alive:
+            return
+        _kill_group(m.proc, signal.SIGTERM)
+        try:
+            m.proc.wait(timeout=self.grace_s)
+        except subprocess.TimeoutExpired:
+            _kill_group(m.proc, signal.SIGKILL)
+            try:
+                m.proc.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                logger.error("cluster: worker %d pid %d survived "
+                             "SIGKILL?!", m.rank, m.proc.pid)
+
+    def _kill_gang(self) -> None:
+        for m in self.members:
+            self._kill_member(m)
+        for m in self.members:
+            if m.proc is not None and m.proc in _LIVE_PROCS \
+                    and m.proc.poll() is not None:
+                _LIVE_PROCS.remove(m.proc)
+
+    # -------------------------------------------------------- detection
+    @staticmethod
+    def _classify_exit(rc: int) -> str:
+        if rc == EXIT_HANG:
+            return "hang_hard"
+        if rc == EXIT_NAN:
+            return "nan_abort"
+        if rc < 0:
+            return f"killed:sig{-rc}"
+        return "crash"
+
+    def _lease_stale(self, m: _Member) -> Optional[str]:
+        """Stale-lease verdict for a LIVE member (None = healthy).
+        The `dist.heartbeat_stale` fault point fires per check; an
+        armed `raise` is consumed as a forced stale verdict."""
+        try:
+            _fire("dist.heartbeat_stale")
+        except FaultInjectedError:
+            return "heartbeat_stale(injected)"
+        hb = HeartbeatFile.read(m.hb_path)
+        if hb is not None and hb.get("status") == "hang":
+            # the watchdog marked the hang but the process has not
+            # exited (e.g. os._exit raced a wedged atexit) — treat as
+            # hung now, don't wait out the lease
+            return "hang_marker"
+        age = HeartbeatFile.age_s(m.hb_path)
+        if age is None:
+            since_spawn = time.monotonic() - m.spawned_at
+            if since_spawn > self.startup_grace_s:
+                return "no_heartbeat_after_startup"
+            return None
+        if age > self.lease_timeout_s:
+            return "heartbeat_stale"
+        return None
+
+    def _watch(self, deadline: Optional[float]) -> List[Tuple[int, str]]:
+        """Block until the gang finishes ([]) or faults ([(rank,
+        reason), ...])."""
+        while True:
+            if deadline is not None and time.monotonic() > deadline:
+                self._kill_gang()
+                raise DeadlineExceededError(
+                    f"cluster run exceeded its deadline with "
+                    f"{self.gang_restarts} gang restarts "
+                    f"(ledger: {self.restart_ledger})")
+            faults: List[Tuple[int, str]] = []
+            running = False
+            for m in self.members:
+                if m.done:
+                    continue
+                rc = m.proc.poll()
+                if rc is not None:
+                    if rc == 0:
+                        m.done = True
+                        if m.proc in _LIVE_PROCS:
+                            _LIVE_PROCS.remove(m.proc)
+                        continue
+                    faults.append((m.rank, self._classify_exit(rc)))
+                    continue
+                running = True
+                verdict = self._lease_stale(m)
+                if verdict is not None:
+                    faults.append((m.rank, verdict))
+            if faults:
+                return faults
+            if not running and all(m.done for m in self.members):
+                return []
+            time.sleep(self.poll_s)
+
+    # ------------------------------------------------------ gang restart
+    def _resume_step(self) -> int:
+        """The shared resume step for the next generation: the newest
+        checkpoint in the shared directory that passes integrity
+        validation — every relaunched rank restores THIS step, so a
+        rank whose filesystem view briefly lags can fail loudly instead
+        of silently resuming elsewhere. 0 = no valid checkpoint, start
+        from scratch."""
+        if not self.checkpoint_dir:
+            return 0
+        step = _ci.newest_valid_checkpoint(
+            self.checkpoint_dir, structural_check=self.structural_check)
+        return 0 if step is None else int(step)
+
+    def _record_faults(self, faults: List[Tuple[int, str]],
+                       resume_step: int) -> None:
+        self.gang_restarts += 1
+        for rank, reason in faults:
+            self.members[rank].restarts += 1
+            self.restart_ledger.append({
+                "gang_restart": self.gang_restarts,
+                "worker": rank,
+                "reason": reason,
+                "worker_restarts": self.members[rank].restarts,
+                "resume_step": resume_step,
+                "t_s": round(time.monotonic() - self._t0, 3),
+            })
+            logger.warning(
+                "cluster: worker %d faulted (%s) — gang restart %d "
+                "from step %d", rank, reason, self.gang_restarts,
+                resume_step)
+        exhausted = [m.rank for m in self.members
+                     if m.restarts > self.max_restarts_per_worker]
+        if exhausted:
+            self.quarantined.extend(
+                r for r in exhausted if r not in self.quarantined)
+            raise RestartsExhaustedError(
+                f"worker(s) {exhausted} exceeded "
+                f"max_restarts_per_worker={self.max_restarts_per_worker}"
+                f" — quarantined, gang aborted",
+                ledger=list(self.restart_ledger))
+        if self.gang_restarts > self.max_gang_restarts:
+            raise RestartsExhaustedError(
+                f"gang exceeded max_gang_restarts="
+                f"{self.max_gang_restarts}",
+                ledger=list(self.restart_ledger))
+
+    # --------------------------------------------------------------- run
+    def run(self, timeout_s: Optional[float] = None) -> dict:
+        """Run the gang to completion (every member exits 0), gang-
+        restarting through faults; returns stats(). Raises
+        RestartsExhaustedError when a member exhausts its restart
+        budget (quarantine) or the gang exhausts its total, and
+        DeadlineExceededError past `timeout_s` — in every exit path the
+        gang is dead first."""
+        self._t0 = time.monotonic()
+        deadline = (None if timeout_s is None
+                    else self._t0 + float(timeout_s))
+        resume_step = self._resume_step()
+        try:
+            while True:
+                self._launch_gang(resume_step)
+                faults = self._watch(deadline)
+                if not faults:
+                    return self.stats()
+                # coherent restart: the whole gang dies (a half-dead
+                # jax.distributed world cannot make progress), then
+                # every rank relaunches on one shared resume step
+                self._kill_gang()
+                resume_step = self._resume_step()
+                self.resume_steps.append(resume_step)
+                self._record_faults(faults, resume_step)
+                time.sleep(self.restart_backoff_s)
+        except BaseException:
+            self._kill_gang()
+            raise
+
+    def stats(self) -> dict:
+        return {
+            "nprocs": self.nprocs,
+            "generations": self.generation,
+            "gang_restarts": self.gang_restarts,
+            "max_restarts_per_worker": self.max_restarts_per_worker,
+            "per_worker_restarts": {
+                m.rank: m.restarts for m in self.members if m.restarts},
+            "quarantined": list(self.quarantined),
+            "resume_steps": list(self.resume_steps),
+            "ledger": [dict(e) for e in self.restart_ledger],
+        }

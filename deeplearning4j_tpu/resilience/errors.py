@@ -89,13 +89,17 @@ class PreemptedError(ResilienceError):
 
 
 class RestartsExhaustedError(ResilienceError):
-    """Supervisor gave up restarting; `cause` is the final crash and
-    `ledger` the full restart history."""
+    """A restart budget is spent: the in-process Supervisor gave up
+    (`cause` is the final crash) or the ClusterSupervisor quarantined a
+    worker that exhausted its per-member budget (`cause` is None — the
+    worker died in another process). `ledger` is the full restart
+    history either way."""
 
-    def __init__(self, msg: str, cause: Exception, ledger: list):
+    def __init__(self, msg: str, cause: Exception | None = None,
+                 ledger: list | None = None):
         super().__init__(msg)
         self.cause = cause
-        self.ledger = ledger
+        self.ledger = ledger or []
 
 
 class ServingError(ResilienceError):

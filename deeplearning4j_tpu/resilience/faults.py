@@ -27,6 +27,17 @@ Fault points wired through the stack:
                      consumed by poisoning that step's batch with NaN,
                      driving real non-finite loss/grads through the
                      step (NonFiniteGuard drill)
+  train.hang_hard    TrainingMaster.fit, once per step, fired with
+                     SIGUSR1+SIGTERM blocked (supervisor.fire_hang_hard)
+                     — `delay` wedges the loop IMMUNE to the watchdog's
+                     signal escalation, the deterministic analogue of a
+                     stuck native collective; only the watchdog's
+                     hard-exit or the ClusterSupervisor's stale-lease
+                     SIGKILL recovers it
+  dist.heartbeat_stale  ClusterSupervisor lease check, once per worker
+                     per poll — `raise` is consumed as a forced
+                     stale-lease verdict (drills the SIGTERM-then-
+                     SIGKILL + gang-restart path without a real hang)
   data.next          around every batch_fn fetch — `raise` simulates a
                      flaky data iterator (retried/skipped per policy)
   inference.batch    ParallelInference batcher loop, once per cycle —
@@ -71,11 +82,13 @@ _MODES = ("raise", "delay", "truncate")
 REGISTERED_POINTS = frozenset({
     "checkpoint.write",
     "data.next",
+    "dist.heartbeat_stale",
     "inference.batch",
     "inference.complete",
     "serve.request",
     "train.grad_nonfinite",
     "train.hang",
+    "train.hang_hard",
     "train.preempt",
     "train.step",
 })

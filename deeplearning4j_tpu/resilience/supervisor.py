@@ -37,6 +37,7 @@ around the whole fit, not around steps.
 from __future__ import annotations
 
 import logging
+import os
 import signal
 import threading
 import time
@@ -49,8 +50,34 @@ from deeplearning4j_tpu.resilience.errors import (
     RestartsExhaustedError,
     StepHangError,
 )
+from deeplearning4j_tpu.resilience.faults import fire as _fire
 
 logger = logging.getLogger("deeplearning4j_tpu")
+
+
+def fire_hang_hard() -> None:
+    """`train.hang_hard` chaos site: a `delay` spec armed here wedges
+    the fit loop with SIGUSR1 *and SIGTERM blocked* — immune to the
+    StepWatchdog's signal escalation AND to a supervisor's polite
+    SIGTERM, the deterministic analogue of a thread stuck inside a
+    native collective. Only the watchdog's hard-exit path (heartbeat
+    marker + os._exit) or an external ClusterSupervisor's
+    stale-lease SIGKILL can recover it."""
+    from deeplearning4j_tpu.resilience.faults import injector
+
+    if not injector().armed or not hasattr(signal, "pthread_sigmask"):
+        # happy path: no chaos armed — skip the two sigmask syscalls,
+        # keep the hit accounting
+        _fire("train.hang_hard")
+        return
+    blocked = {s for s in (getattr(signal, "SIGUSR1", None),
+                           getattr(signal, "SIGTERM", None))
+               if s is not None}
+    old = signal.pthread_sigmask(signal.SIG_BLOCK, blocked)
+    try:
+        _fire("train.hang_hard")
+    finally:
+        signal.pthread_sigmask(signal.SIG_SETMASK, old)
 
 POLICIES = ("skip_step", "rollback", "abort")
 
@@ -183,6 +210,41 @@ class NonFiniteGuard:
                 **self.counters}
 
 
+class PeriodicSnapshotter:
+    """In-memory rollback targets for fit loops that have no
+    checkpoint directory (ParallelWrapper, EarlyStoppingTrainer):
+    a device-copy snapshot (params / updater state / BN states / rng /
+    iteration, via NonFiniteGuard.snapshot) of the PRE-step state every
+    `every` guarded steps; `restore()` rewinds the net to the newest
+    one — so NonFiniteGuard(policy='rollback') works everywhere, not
+    just under TrainingMaster checkpoints. Cost: one extra jitted
+    tree-copy dispatch per `every` steps (the skip_step snapshot,
+    amortized); recovery loses at most `every - 1` good steps."""
+
+    def __init__(self, guard: "NonFiniteGuard", every: int = 8):
+        self.guard = guard
+        self.every = max(1, int(every))
+        self.counters = {"snapshots": 0, "restores": 0}
+        self._snap = None
+        self._calls = 0
+
+    def maybe_snapshot(self, net) -> None:
+        """Call BEFORE running a step: refreshes the rollback target on
+        the cadence (and always on the very first step, so a target
+        exists before the first possible poison)."""
+        if self._snap is None or self._calls % self.every == 0:
+            self._snap = self.guard.snapshot(net)
+            self.counters["snapshots"] += 1
+        self._calls += 1
+
+    def restore(self, net) -> None:
+        self.guard.restore(net, self._snap)
+        self.counters["restores"] += 1
+
+    def stats(self) -> dict:
+        return {"every": self.every, **self.counters}
+
+
 class StepWatchdog:
     """Detect a wedged fit loop. The loop calls `beat()` around
     dispatch/fetch (one clock read); a monitor thread checks heartbeat
@@ -192,28 +254,49 @@ class StepWatchdog:
     (sleeps, gloo/python-level polls) so the Supervisor can restart
     from the newest checkpoint instead of the job hanging forever.
     Pass `on_hang=fn(phase, age_s)` to override escalation (e.g. page,
-    or `os._exit` for truly uninterruptible native hangs)."""
+    or `os._exit` for truly uninterruptible native hangs).
+
+    Cluster mode: pass `heartbeat=HeartbeatFile(...)` (resilience/
+    cluster.py) and every beat also renews the worker's liveness lease
+    (throttled inside HeartbeatFile). With a heartbeat attached the
+    watchdog ALSO gets the default escalation for the uninterruptible
+    case: after `hang_exit_after` consecutive hang detections with no
+    fresh beat between them (the SIGUSR1 raise never landed — the wait
+    is signal-immune), the monitor thread writes a hang marker into the
+    lease and `os._exit(EXIT_HANG)`s, so the external ClusterSupervisor
+    relaunches the gang instead of the job hanging forever."""
 
     def __init__(self, timeout_s: float = 300.0,
                  poll_s: Optional[float] = None,
-                 on_hang: Optional[Callable[[str, float], None]] = None):
+                 on_hang: Optional[Callable[[str, float], None]] = None,
+                 heartbeat=None, hang_exit_after: int = 2):
         self.timeout_s = float(timeout_s)
         self.poll_s = poll_s if poll_s is not None else min(
             1.0, max(0.05, self.timeout_s / 4.0))
         self.on_hang = on_hang
+        self.heartbeat = heartbeat
+        self.hang_exit_after = int(hang_exit_after)
         self.counters = {"beats": 0, "hangs_detected": 0}
         self._last: Optional[float] = None
         self._phase = "idle"
+        self._step: Optional[int] = None
+        self._beats_at_hang: Optional[int] = None
+        self._consecutive_hangs = 0
         self._stop: Optional[threading.Event] = None
         self._thread: Optional[threading.Thread] = None
         self._target_tid: Optional[int] = None
         self._old_handler = None
 
     # ------------------------------------------------------------ beats
-    def beat(self, phase: str = "step") -> None:
+    def beat(self, phase: str = "step",
+             step: Optional[int] = None) -> None:
         self._phase = phase
+        if step is not None:
+            self._step = step
         self._last = time.monotonic()
         self.counters["beats"] += 1
+        if self.heartbeat is not None:
+            self.heartbeat.write(phase=phase, step=self._step)
 
     # -------------------------------------------------------- lifecycle
     def start(self) -> "StepWatchdog":
@@ -268,8 +351,33 @@ class StepWatchdog:
                 continue
             self.counters["hangs_detected"] += 1
             self._last = time.monotonic()   # re-arm, don't spam
+            # consecutive = no fresh beat since the previous detection:
+            # the soft (signal) escalation did not land
+            if self._beats_at_hang == self.counters["beats"]:
+                self._consecutive_hangs += 1
+            else:
+                self._consecutive_hangs = 1
+            self._beats_at_hang = self.counters["beats"]
             logger.error("StepWatchdog: no heartbeat for %.1fs "
                          "(phase %r) — escalating", age, self._phase)
+            if (self.heartbeat is not None
+                    and self._consecutive_hangs >= self.hang_exit_after):
+                # uninterruptible hang: the training thread survived a
+                # SIGUSR1 raise without beating — write the marker and
+                # hard-exit so the ClusterSupervisor relaunches the gang
+                from deeplearning4j_tpu.resilience.cluster import (
+                    EXIT_HANG,
+                )
+
+                logger.error(
+                    "StepWatchdog: %d consecutive silent hangs (phase "
+                    "%r) — marking heartbeat and exiting %d for "
+                    "external relaunch", self._consecutive_hangs,
+                    self._phase, EXIT_HANG)
+                try:
+                    self.heartbeat.mark_hang(self._phase, age)
+                finally:
+                    os._exit(EXIT_HANG)
             try:
                 if self.on_hang is not None:
                     self.on_hang(self._phase, age)

@@ -67,6 +67,11 @@ if (DATA.resilience) {{
     `/${{R.supervisor.max_restarts}}`);
   if (R.counters) parts.push(
     `data-skipped steps: ${{R.counters.data_skipped_steps}}`);
+  if (R.cluster) parts.push(
+    `cluster: ${{R.cluster.gang_restarts}} gang restarts over ` +
+    `${{R.cluster.generations}} generations` +
+    (R.cluster.quarantined.length
+      ? `, quarantined workers [${{R.cluster.quarantined}}]` : ''));
   document.getElementById('resil').innerHTML =
     '<p class="meta">self-healing — ' + parts.join(' · ') + '</p>';
 }}
@@ -340,7 +345,8 @@ def render_html(storage: StatsStorage, session_id: Optional[str] = None,
     network-graph tabs; `resilience`
     (TrainingMaster.resilience_stats()) renders the self-healing
     counter line (guard skips/rollbacks, watchdog hangs, preemptions,
-    supervisor restarts)."""
+    supervisor restarts; add a `cluster` key — ClusterSupervisor
+    .stats() — for gang-restart/quarantine counters)."""
     sessions = storage.session_ids()
     if not sessions:
         raise ValueError("storage has no sessions")

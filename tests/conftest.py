@@ -69,6 +69,21 @@ def _hang_guard(request):
 
 
 @pytest.fixture(autouse=True)
+def _reap_cluster_workers():
+    """Chaos isolation for PROCESSES: a failing/interrupted cluster
+    chaos test must not leak supervised worker processes (each spawned
+    in its own process group) into later tier-1 runs — kill any process
+    group the ClusterSupervisor still tracks on teardown. Lazy: touches
+    nothing unless the cluster module was actually imported."""
+    import sys as _sys
+
+    yield
+    mod = _sys.modules.get("deeplearning4j_tpu.resilience.cluster")
+    if mod is not None:
+        mod.reap_stray_workers()
+
+
+@pytest.fixture(autouse=True)
 def _clear_faults():
     """Chaos isolation: no armed fault may leak into the next test."""
     from deeplearning4j_tpu.resilience.faults import injector

@@ -7,6 +7,13 @@ early ("kill between steps") and resumes from checkpoints.
 
 Usage: distributed_worker.py PID NPROCS PORT STEPS OUT_DIR
            [--stop-after N] [--checkpoint-every N]
+
+`--cluster` runs the worker as a ClusterSupervisor gang member: a
+HeartbeatFile lease is renewed from the StepWatchdog beat path
+(`--heartbeat-dir`, `--hang-timeout`), the shared resume step from the
+supervisor is honored exactly (`--resume-step`, the gang-restart
+handshake), and a NonFiniteLossError under `--guard abort` exits with
+EXIT_NAN so the supervisor can classify the failure from the exit code.
 """
 
 import argparse
@@ -62,6 +69,16 @@ def main():
     # crashes (e.g. an armed train.step fault simulating worker loss)
     # resume from the newest valid checkpoint instead of failing the job
     ap.add_argument("--supervise", type=int, default=0)
+    # gang-member mode under resilience.cluster.ClusterSupervisor
+    ap.add_argument("--cluster", action="store_true")
+    ap.add_argument("--heartbeat-dir", default="")
+    ap.add_argument("--resume-step", type=int, default=-1)
+    ap.add_argument("--hang-timeout", type=float, default=0.0)
+    ap.add_argument("--guard", default="",
+                    choices=("", "abort"))
+    # per-step host-side sleep: widens the mid-step window so an
+    # external chaos killer can land deterministically
+    ap.add_argument("--spin-ms", type=float, default=0.0)
     args = ap.parse_args()
 
     from deeplearning4j_tpu.parallel.training_master import TrainingMaster
@@ -75,13 +92,43 @@ def main():
     net = build_net()
     ckpt = (os.path.join(args.out_dir, "ckpt")
             if args.checkpoint_every else None)
+    hb = wd = guard = None
+    if args.cluster:
+        from deeplearning4j_tpu.resilience.cluster import (
+            EXIT_NAN,
+            HeartbeatFile,
+            heartbeat_path,
+        )
+        from deeplearning4j_tpu.resilience.supervisor import StepWatchdog
+
+        hb = HeartbeatFile(
+            heartbeat_path(args.heartbeat_dir or args.out_dir,
+                           args.pid))
+        # hang-timeout 0 = lease emission only (the EXTERNAL stale-lease
+        # kill is the recovery path); > 0 additionally arms the
+        # watchdog's SIGUSR1-then-hard-exit escalation
+        wd = StepWatchdog(timeout_s=args.hang_timeout or 1e9,
+                          poll_s=min(0.25, (args.hang_timeout or 1e9)
+                                     / 4.0),
+                          heartbeat=hb)
+    if args.guard == "abort":
+        from deeplearning4j_tpu.resilience.supervisor import (
+            NonFiniteGuard,
+        )
+
+        guard = NonFiniteGuard(policy="abort", check_every=1)
     tm = TrainingMaster(
         net, checkpoint_dir=ckpt,
         checkpoint_every=args.checkpoint_every,
         averaging_frequency=args.averaging_frequency,
-        threshold_compression=args.threshold_compression)
+        threshold_compression=args.threshold_compression,
+        watchdog=wd, guard=guard)
 
     def batch_fn(step):
+        if args.spin_ms > 0:
+            import time
+
+            time.sleep(args.spin_ms / 1e3)
         x, y = global_batch(step)
         per = GLOBAL_BATCH // args.nprocs
         s = args.pid * per
@@ -96,6 +143,22 @@ def main():
                          initial_backoff_s=0.2, max_backoff_s=1.0)
         sup.run(tm.fit, batch_fn, steps)
         restarts = len(sup.restart_ledger)
+    elif args.cluster:
+        from deeplearning4j_tpu.resilience.errors import (
+            NonFiniteLossError,
+        )
+
+        # resume handshake: the supervisor chose ONE step for the whole
+        # gang; honor it exactly (<0 = first launch, auto-resume)
+        start = None
+        if args.resume_step >= 0:
+            start = tm.load_checkpoint_at(args.resume_step)
+        try:
+            tm.fit(batch_fn, steps, start_step=start)
+        except NonFiniteLossError:
+            hb.mark("nan_abort")
+            sys.exit(EXIT_NAN)
+        hb.mark("done")
     else:
         tm.fit(batch_fn, steps)
 

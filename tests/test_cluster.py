@@ -1,0 +1,373 @@
+"""Cluster-supervision tests (PR 4 tentpole): HeartbeatFile leases,
+ClusterSupervisor gang restart (crash / SIGKILL / hard hang / injected
+stale lease), worker quarantine (`RestartsExhaustedError`), the
+resume-step handshake, and the bounded-wall-time guarantee.
+
+Fast tests use trivial python -c workers (no jax) and are tier-1; the
+2-process jax.distributed gang drills are marked chaos+slow.
+
+Named fault points exercised here: `dist.heartbeat_stale` (forced
+stale-lease verdict in the supervisor) and `train.hang_hard` (SIGUSR1-
+immune wedge in the worker fit loop).
+"""
+
+import os
+import signal
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.resilience import (
+    ClusterSupervisor,
+    DeadlineExceededError,
+    HeartbeatFile,
+    RestartsExhaustedError,
+    heartbeat_path,
+    injector,
+)
+
+HELPER = os.path.join(os.path.dirname(__file__), "helpers",
+                      "distributed_worker.py")
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+# ================================================= heartbeat leases
+def test_heartbeat_file_roundtrip_and_throttle(tmp_path):
+    path = str(tmp_path / "w.hb.json")
+    hb = HeartbeatFile(path, min_interval_s=10.0)
+    hb.write(phase="dispatch", step=3)
+    rec = HeartbeatFile.read(path)
+    assert rec["pid"] == os.getpid()
+    assert rec["step"] == 3 and rec["phase"] == "dispatch"
+    assert rec["status"] == "running"
+    assert HeartbeatFile.age_s(path) < 5.0
+
+    # same-status writes inside the interval are throttled (the beat
+    # path must not pay a disk write per step)
+    hb.write(phase="fetch", step=4)
+    assert hb.counters == {"writes": 1, "throttled": 1}
+    assert HeartbeatFile.read(path)["step"] == 3
+
+    # a status CHANGE always lands, throttle or not
+    hb.mark_hang("dispatch", 12.0)
+    rec = HeartbeatFile.read(path)
+    assert rec["status"] == "hang" and rec["step"] == 4
+
+    assert HeartbeatFile.read(str(tmp_path / "missing")) is None
+    assert HeartbeatFile.age_s(str(tmp_path / "missing")) is None
+
+
+def _hb_writer_script(hb_dir: str, rank: int, loop: bool) -> str:
+    """A trivial no-jax worker: renew the lease, then exit 0 (loop=False)
+    or renew forever (loop=True)."""
+    body = ("while True:\n    hb.write(step=1, force=True)\n"
+            "    time.sleep(0.05)\n" if loop
+            else "hb.write(step=1, force=True)\nhb.mark('done')\n")
+    return (
+        "import sys, time\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "from deeplearning4j_tpu.resilience.cluster import (\n"
+        "    HeartbeatFile, heartbeat_path)\n"
+        f"hb = HeartbeatFile(heartbeat_path({hb_dir!r}, {rank}))\n"
+        + body)
+
+
+# ================================================= supervisor basics
+def test_cluster_success_path(tmp_path):
+    hb_dir = str(tmp_path / "hb")
+
+    def command_fn(rank, nprocs, port, resume_step):
+        assert nprocs == 2 and port > 0 and resume_step == 0
+        return [sys.executable, "-c",
+                _hb_writer_script(hb_dir, rank, loop=False)]
+
+    cs = ClusterSupervisor(2, command_fn, hb_dir, poll_s=0.05,
+                           startup_grace_s=60.0)
+    stats = cs.run(timeout_s=60.0)
+    assert stats["gang_restarts"] == 0
+    assert stats["generations"] == 1
+    assert stats["quarantined"] == [] and stats["ledger"] == []
+    for rank in range(2):
+        assert HeartbeatFile.read(
+            heartbeat_path(hb_dir, rank))["status"] == "done"
+
+
+@pytest.mark.chaos
+def test_cluster_quarantine_after_restart_budget(tmp_path):
+    """A member that keeps crashing exhausts its per-worker budget: the
+    supervisor quarantines it and aborts the GANG with
+    RestartsExhaustedError — bounded recovery, and the healthy member
+    is killed too (a half gang cannot make progress)."""
+    hb_dir = str(tmp_path / "hb")
+
+    def command_fn(rank, nprocs, port, resume_step):
+        if rank == 0:
+            return [sys.executable, "-c", "import sys; sys.exit(3)"]
+        return [sys.executable, "-c",
+                _hb_writer_script(hb_dir, rank, loop=True)]
+
+    cs = ClusterSupervisor(2, command_fn, hb_dir, poll_s=0.05,
+                           grace_s=0.5, restart_backoff_s=0.05,
+                           max_restarts_per_worker=1,
+                           startup_grace_s=60.0)
+    t0 = time.monotonic()
+    with pytest.raises(RestartsExhaustedError) as ei:
+        cs.run(timeout_s=60.0)
+    assert time.monotonic() - t0 < 30.0          # never an open-ended hang
+    assert cs.quarantined == [0]
+    assert cs.gang_restarts == 2                 # budget 1 + the final straw
+    assert [e["worker"] for e in ei.value.ledger] == [0, 0]
+    assert all(e["reason"] == "crash" for e in ei.value.ledger)
+    for m in cs.members:                         # nothing leaked
+        assert not m.alive
+
+
+@pytest.mark.chaos
+def test_cluster_injected_stale_lease_kills_live_worker(tmp_path):
+    """`dist.heartbeat_stale` armed in the SUPERVISOR process forces a
+    stale verdict on a perfectly live worker: the SIGTERM-then-SIGKILL
+    + gang-restart path runs without a real 60-second hang."""
+    hb_dir = str(tmp_path / "hb")
+
+    def command_fn(rank, nprocs, port, resume_step):
+        return [sys.executable, "-c",
+                _hb_writer_script(hb_dir, rank, loop=True)]
+
+    injector().inject("dist.heartbeat_stale", at_hit=1)
+    cs = ClusterSupervisor(2, command_fn, hb_dir, poll_s=0.05,
+                           grace_s=0.5, restart_backoff_s=0.05,
+                           max_restarts_per_worker=0,
+                           startup_grace_s=60.0)
+    with pytest.raises(RestartsExhaustedError) as ei:
+        cs.run(timeout_s=60.0)
+    assert ei.value.ledger[0]["reason"] == "heartbeat_stale(injected)"
+    assert cs.quarantined == [0]
+    for m in cs.members:
+        assert not m.alive
+
+
+@pytest.mark.chaos
+def test_cluster_run_deadline_never_hangs(tmp_path):
+    """A gang that is healthy but never finishes is still bounded:
+    run(timeout_s) kills it and raises instead of waiting forever."""
+    hb_dir = str(tmp_path / "hb")
+
+    def command_fn(rank, nprocs, port, resume_step):
+        return [sys.executable, "-c",
+                _hb_writer_script(hb_dir, rank, loop=True)]
+
+    cs = ClusterSupervisor(1, command_fn, hb_dir, poll_s=0.05,
+                           grace_s=0.5, startup_grace_s=60.0)
+    with pytest.raises(DeadlineExceededError):
+        cs.run(timeout_s=1.5)
+    assert not cs.members[0].alive
+
+
+def test_cluster_resume_step_scan_prefers_newest_valid(tmp_path):
+    """The gang-restart handshake picks the newest checkpoint passing
+    integrity validation — a torn newest file is skipped (the existing
+    checkpoint_integrity scan, reused verbatim)."""
+    from deeplearning4j_tpu.resilience import record_checksum, sha256_file
+
+    ck = tmp_path / "ckpt"
+    ck.mkdir()
+    for step, payload in ((2, b"x" * 64), (4, b"y" * 64)):
+        p = ck / f"step-{step:08d}.npz"
+        p.write_bytes(payload)
+        record_checksum(str(ck), p.name, sha256_file(str(p)), 64,
+                        extra={"step": step})
+    cs = ClusterSupervisor(1, lambda *a: ["true"], str(tmp_path / "hb"),
+                           checkpoint_dir=str(ck))
+    assert cs._resume_step() == 4
+    # tear the newest: the handshake falls back to step 2
+    (ck / "step-00000004.npz").write_bytes(b"y" * 32)
+    assert cs._resume_step() == 2
+    cs_none = ClusterSupervisor(1, lambda *a: ["true"],
+                                str(tmp_path / "hb2"))
+    assert cs_none._resume_step() == 0
+
+
+# ================================================= 2-process jax gangs
+def _worker_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_PLATFORM_NAME"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env.pop("JAX_COORDINATOR_ADDRESS", None)
+    env.pop("DL4J_TPU_FAULTS", None)
+    return env
+
+
+def _gang_cmd_fn(steps, out_dir, hb_dir, hang_timeout=0.0, extra=()):
+    def command_fn(rank, nprocs, port, resume_step):
+        ht = (hang_timeout(rank) if callable(hang_timeout)
+              else hang_timeout)
+        return [sys.executable, HELPER, str(rank), str(nprocs),
+                str(port), str(steps), out_dir,
+                "--checkpoint-every", "1",
+                "--cluster", "--heartbeat-dir", hb_dir,
+                "--resume-step", str(resume_step),
+                "--hang-timeout", str(ht), *extra]
+    return command_fn
+
+
+def _gang_supervisor(out, steps=6, hang_timeout=0.0, extra=(), **kw):
+    hb_dir = os.path.join(out, "hb")
+    kw.setdefault("lease_timeout_s", 120.0)
+    kw.setdefault("startup_grace_s", 240.0)
+    kw.setdefault("poll_s", 0.2)
+    kw.setdefault("restart_backoff_s", 0.2)
+    kw.setdefault("env", _worker_env())
+    return ClusterSupervisor(
+        2, _gang_cmd_fn(steps, out, hb_dir, hang_timeout, extra),
+        hb_dir, checkpoint_dir=os.path.join(out, "ckpt"), **kw)
+
+
+def _final(out):
+    data = np.load(os.path.join(out, "final_params.npz"))
+    return ([data[k] for k in data.files if k.startswith("arr_")],
+            int(data["iteration"]))
+
+
+def _assert_parity(out, oracle):
+    got, iteration = _final(out)
+    ref, ref_iter = oracle
+    assert iteration == ref_iter
+    assert len(got) == len(ref)
+    for g, e in zip(got, ref):
+        # gang relaunch replays the identical data/rng stream from the
+        # shared resume step
+        np.testing.assert_allclose(g, e, rtol=1e-6, atol=1e-7)
+
+
+@pytest.fixture(scope="module")
+def gang_oracle(tmp_path_factory):
+    """Un-faulted 2-process cluster run: the parity reference for every
+    gang-restart drill (and the success-path proof for real workers)."""
+    out = str(tmp_path_factory.mktemp("gang_oracle"))
+    cs = _gang_supervisor(out)
+    stats = cs.run(timeout_s=280.0)
+    assert stats["gang_restarts"] == 0
+    return _final(out)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_cluster_gang_restart_after_worker_sigkill(tmp_path_factory,
+                                                   gang_oracle):
+    """Acceptance: one worker SIGKILLed mid-step (from outside, via the
+    pid in its own heartbeat lease). The supervisor detects the death,
+    kills the survivor, and relaunches the gang from the newest common
+    valid checkpoint; final params match the un-faulted oracle."""
+    out = str(tmp_path_factory.mktemp("gang_kill"))
+    cs = _gang_supervisor(out, extra=("--spin-ms", "250"),
+                          max_restarts_per_worker=2)
+    hb_dir = os.path.join(out, "hb")
+    killed = {}
+
+    def killer():
+        while not killed:
+            rec = HeartbeatFile.read(heartbeat_path(hb_dir, 1))
+            if (rec and rec.get("status") == "running"
+                    and (rec.get("step") or 0) >= 2):
+                try:
+                    os.kill(rec["pid"], signal.SIGKILL)
+                    killed["pid"] = rec["pid"]
+                except ProcessLookupError:
+                    pass
+                return
+            time.sleep(0.05)
+
+    th = threading.Thread(target=killer, daemon=True)
+    th.start()
+    stats = cs.run(timeout_s=280.0)
+    th.join(timeout=5.0)
+    assert killed, "chaos killer never fired"
+    assert stats["gang_restarts"] == 1
+    assert any(e["worker"] == 1 and e["reason"] == "killed:sig9"
+               for e in stats["ledger"])
+    assert stats["resume_steps"] and stats["resume_steps"][0] >= 1
+    _assert_parity(out, gang_oracle)
+
+
+def _one_shot_hang_env(delay_spec):
+    """Arm `train.hang_hard` on rank 0 of the FIRST generation only —
+    relaunched gangs get a clean environment, so one fault means one
+    gang restart."""
+    launches = {"n": 0}
+
+    def env_fn(rank):
+        if rank == 0:
+            launches["n"] += 1
+            if launches["n"] == 1:
+                return {"DL4J_TPU_FAULTS": delay_spec}
+        return {}
+
+    return env_fn
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_cluster_gang_restart_after_uninterruptible_hang(
+        tmp_path_factory, gang_oracle):
+    """Acceptance: rank 0 wedges in a SIGUSR1+SIGTERM-immune sleep
+    (`train.hang_hard`) with NO in-process watchdog escalation — only
+    the supervisor's stale-lease detection can see it. The lease goes
+    stale, SIGTERM is ignored (blocked), SIGKILL lands, the gang
+    relaunches from the newest common checkpoint, and final params
+    match the oracle exactly."""
+    out = str(tmp_path_factory.mktemp("gang_hang"))
+    cs = _gang_supervisor(
+        out, hang_timeout=0.0,         # lease emission only
+        lease_timeout_s=15.0, poll_s=0.3, grace_s=1.0,
+        max_restarts_per_worker=3,
+        env_fn=_one_shot_hang_env("train.hang_hard:delay@3~120.0"))
+    stats = cs.run(timeout_s=280.0)
+    assert stats["gang_restarts"] == 1
+    reasons = {e["worker"]: e["reason"] for e in stats["ledger"]}
+    assert "heartbeat_stale" in reasons[0]
+    _assert_parity(out, gang_oracle)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_cluster_hard_hang_watchdog_exit_code(tmp_path_factory,
+                                              gang_oracle):
+    """The other half of the hard-hang story: with a heartbeat-attached
+    StepWatchdog, the monitor thread survives the wedged training
+    thread, sees its SIGUSR1 never landed, writes the hang marker, and
+    os._exit(EXIT_HANG)s — the supervisor classifies `hang_hard` from
+    the exit code and relaunches without waiting out the lease."""
+    out = str(tmp_path_factory.mktemp("gang_wd_exit"))
+    cs = _gang_supervisor(
+        out,
+        hang_timeout=lambda rank: 4.0 if rank == 0 else 0.0,
+        lease_timeout_s=120.0, grace_s=1.0,
+        max_restarts_per_worker=3,
+        env_fn=_one_shot_hang_env("train.hang_hard:delay@3~120.0"))
+    stats = cs.run(timeout_s=280.0)
+    assert stats["gang_restarts"] == 1
+    # either observation of the hard-exit escalation counts: the
+    # EXIT_HANG code, or the hang marker the watchdog wrote into the
+    # lease just before os._exit (whichever the poll sees first)
+    assert any(e["worker"] == 0
+               and e["reason"] in ("hang_hard", "hang_marker")
+               for e in stats["ledger"])
+    hb = HeartbeatFile.read(
+        heartbeat_path(os.path.join(out, "hb"), 0))
+    # the marker from generation 0 was replaced by generation 1's lease
+    assert hb["status"] == "done"
+    _assert_parity(out, gang_oracle)
+
+
+# ================================================= stats surfacing
+def test_cluster_stats_shape():
+    cs = ClusterSupervisor(3, lambda *a: ["true"], "/tmp/_hb_unused")
+    stats = cs.stats()
+    assert stats["nprocs"] == 3
+    assert stats["gang_restarts"] == 0
+    assert stats["per_worker_restarts"] == {}
+    assert stats["quarantined"] == [] and stats["ledger"] == []

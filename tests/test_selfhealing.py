@@ -404,12 +404,54 @@ def test_parallel_wrapper_guard_skips_nan_batch():
     _assert_same_params(net, clean_net)
 
 
-def test_parallel_wrapper_rejects_rollback_guard():
+def test_parallel_wrapper_rejects_rollback_guard_without_snapshots():
     from deeplearning4j_tpu.parallel import ParallelWrapper
 
     with pytest.raises(ValueError):
         ParallelWrapper(_net(), workers=2,
                         guard=NonFiniteGuard(policy="rollback"))
+    # with a snapshot cadence the policy is supported everywhere
+    pw = ParallelWrapper(_net(), workers=2,
+                         guard=NonFiniteGuard(policy="rollback"),
+                         snapshot_every=4)
+    assert pw._snapshotter is not None and pw._snapshotter.every == 4
+
+
+@pytest.mark.chaos
+def test_parallel_wrapper_rollback_snapshot_restores_state():
+    """Satellite (ROADMAP gap): NonFiniteGuard(policy='rollback') now
+    works under ParallelWrapper via the periodic in-memory snapshot
+    hook — a poisoned batch rewinds to the newest snapshot and the run
+    equals one that never saw the poisoned window, with byte-identical
+    updater state."""
+    import jax
+
+    from deeplearning4j_tpu.parallel import ParallelWrapper, make_mesh
+
+    devices = jax.devices("cpu")[:4]
+    batches = [_batch(s) for s in range(6)]
+    bad = (np.full_like(batches[3][0], np.nan), batches[3][1])
+    poisoned = batches[:3] + [bad] + batches[4:]
+
+    g = NonFiniteGuard(policy="rollback", check_every=1)
+    net = _net()
+    pw = ParallelWrapper(net, mesh=make_mesh(dp=4, devices=devices),
+                         guard=g, snapshot_every=2)
+    # snapshots refresh before steps 0, 2, 4; the poison at step 3
+    # rewinds to the step-2 snapshot, so steps 2 and 3 are the lost
+    # window and training continues with batches 4, 5
+    pw.fit(poisoned)
+    assert g.counters["rollbacks"] == 1
+    assert pw._snapshotter.counters["restores"] == 1
+
+    clean_net = _net()
+    ParallelWrapper(clean_net,
+                    mesh=make_mesh(dp=4, devices=devices)).fit(
+                        batches[:2] + batches[4:])
+    _assert_same_params(net, clean_net)
+    for a, b in zip(_upd(net), _upd(clean_net)):
+        assert a.tobytes() == b.tobytes()
+    assert net.iteration == clean_net.iteration
 
 
 @pytest.mark.chaos
@@ -432,6 +474,108 @@ def test_earlystopping_guard_skips_nonfinite_batch():
     result = EarlyStoppingTrainer(cfg, _net(), data, guard=g).fit()
     assert g.counters["skipped_steps"] >= 1
     assert np.isfinite(result.best_model_score)
+
+
+@pytest.mark.chaos
+def test_earlystopping_rollback_snapshot(rng):
+    """Satellite (ROADMAP gap): rollback policy under
+    EarlyStoppingTrainer via the periodic-snapshot hook. With
+    snapshot_every=1 the rewind is exactly the pre-batch state, so the
+    run equals one that never saw the poisoned batch."""
+    from deeplearning4j_tpu.earlystopping import (
+        EarlyStoppingConfiguration,
+        EarlyStoppingTrainer,
+        MaxEpochsTerminationCondition,
+    )
+    from deeplearning4j_tpu.earlystopping.saver import InMemoryModelSaver
+
+    with pytest.raises(ValueError):
+        EarlyStoppingTrainer(None, _net(), [],
+                             guard=NonFiniteGuard(policy="rollback"))
+
+    batches = [_batch(s) for s in range(4)]
+    bad = (np.full_like(batches[1][0], np.nan), batches[1][1])
+    data = batches[:1] + [bad] + batches[2:]
+
+    g = NonFiniteGuard(policy="rollback", check_every=1)
+    cfg = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(2)],
+        model_saver=InMemoryModelSaver(), evaluate_every_n_epochs=1)
+    net = _net()
+    result = EarlyStoppingTrainer(cfg, net, data, guard=g,
+                                  snapshot_every=1).fit()
+    # the bad batch appears once per epoch: two rollbacks over 2 epochs
+    assert g.counters["rollbacks"] == 2
+    assert np.isfinite(result.best_model_score)
+
+    clean = _net()
+    cfg2 = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(2)],
+        model_saver=InMemoryModelSaver(), evaluate_every_n_epochs=1)
+    EarlyStoppingTrainer(cfg2, clean, batches[:1] + batches[2:]).fit()
+    _assert_same_params(net, clean)
+    for a, b in zip(_upd(net), _upd(clean)):
+        assert a.tobytes() == b.tobytes()
+
+
+# ================================================= local-SGD granularity
+def _require_shard_map():
+    """Local-SGD group programs need jax.shard_map; some environments
+    ship a jax where it is absent (the known pre-existing failure set)
+    — skip instead of enlarging that set."""
+    import jax
+
+    if not hasattr(jax, "shard_map"):
+        pytest.skip("jax.shard_map unavailable in this environment")
+
+
+@pytest.mark.chaos
+def test_local_sgd_inner_step_guard_localizes_poison(tmp_path):
+    _require_shard_map()
+    """Satellite: with guard_inner_steps=True the group program returns
+    per-inner-step losses, so a poisoned batch condemns ONE step of the
+    k-step window instead of the whole window — the replay keeps the
+    healthy sibling steps."""
+    net = _net()
+    g = NonFiniteGuard(policy="rollback", check_every=1)
+    tm = TrainingMaster(net, checkpoint_dir=str(tmp_path),
+                        checkpoint_every=1, guard=g,
+                        averaging_frequency=2, guard_inner_steps=True)
+    # _maybe_poison fires once per inner fetch: hit 4 = step 3 (the
+    # 2nd member of the [2, 3] group)
+    injector().inject("train.grad_nonfinite", at_hit=4)
+    tm.fit(lambda s: _batch(s), 6)
+    assert tm._poisoned_steps == {3}, \
+        "inner-step localization must not condemn the whole window"
+    assert g.counters["rollbacks"] == 1
+    _assert_checkpoints_finite(tm, str(tmp_path))
+
+    # oracle: a local-SGD run that never saw batch 3
+    oracle = _net()
+    order = [0, 1, 2, 4, 5]
+    TrainingMaster(oracle, averaging_frequency=2).fit(
+        lambda s: _batch(order[s]), len(order))
+    # groups differ after the poison ([2],[4,5] vs [2,4],[5]) so exact
+    # parity is not defined — the contract here is localization +
+    # finite checkpoints + a finite converging run
+    assert np.isfinite(float(net.score()))
+
+
+@pytest.mark.chaos
+def test_local_sgd_default_guard_granularity_unchanged(tmp_path):
+    """Flag off (default): the group check still condemns the whole
+    window (the pre-existing contract), and the compiled group program
+    returns no per-step losses."""
+    _require_shard_map()
+    net = _net()
+    g = NonFiniteGuard(policy="rollback", check_every=1)
+    tm = TrainingMaster(net, checkpoint_dir=str(tmp_path),
+                        checkpoint_every=1, guard=g,
+                        averaging_frequency=2)
+    injector().inject("train.grad_nonfinite", at_hit=4)   # step 3
+    tm.fit(lambda s: _batch(s), 6)
+    assert tm._poisoned_steps == {2, 3}
+    assert tm._local_step.last_step_losses is None
 
 
 # ================================================= fault-point registry
@@ -458,6 +602,12 @@ def test_fault_point_registry_matches_source_and_tests():
     untested = sorted(pt for pt in REGISTERED_POINTS if pt not in blob)
     assert not untested, f"fault points with no test naming them: " \
                          f"{untested}"
+
+    # PR 4 pins: the cluster-supervision fault domains are registered
+    # (a regression dropping them from the registry or their fire sites
+    # fails the set equality above; this names them explicitly)
+    assert {"dist.heartbeat_stale", "train.hang_hard"} \
+        <= set(REGISTERED_POINTS)
 
 
 # ================================================= orbax manifest parity
@@ -620,5 +770,13 @@ def test_dashboard_renders_resilience_line(tmp_path):
     g = NonFiniteGuard(policy="skip_step", check_every=1)
     tm = TrainingMaster(net, guard=g)
     tm.fit(lambda s: _batch(s), 2)
-    page = render_html(storage, resilience=tm.resilience_stats())
+    # cluster counters ride the same resilience block (satellite:
+    # gang-restart/quarantine visibility in the dashboard)
+    from deeplearning4j_tpu.resilience import ClusterSupervisor
+
+    cs = ClusterSupervisor(2, lambda *a: ["true"],
+                           str(tmp_path / "hb"))
+    resil = dict(tm.resilience_stats(), cluster=cs.stats())
+    page = render_html(storage, resilience=resil)
     assert "DATA.resilience" in page and '"policy": "skip_step"' in page
+    assert '"gang_restarts": 0' in page and "R.cluster" in page
