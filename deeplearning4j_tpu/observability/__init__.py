@@ -1,0 +1,31 @@
+"""Unified telemetry substrate: metrics registry, span tracing,
+Prometheus exposition, trace export.
+
+One low-overhead layer beneath every workload (training, serving,
+checkpointing, resilience) — the TPP-style uniform instrumentation
+argument applied to this stack. See metrics.py and tracing.py module
+docstrings for the design; README "Observability" for the operator
+recipes (scrape /metrics, export a Perfetto trace)."""
+
+from deeplearning4j_tpu.observability.metrics import (  # noqa: F401
+    DERIVED_METRICS,
+    MetricsRegistry,
+    REGISTERED_METRICS,
+    StepAccumulator,
+    count,
+    count_observe,
+    enable,
+    gauge_fn,
+    get_registry,
+    observe,
+    parse_prometheus,
+    set_gauge,
+    telemetry_enabled,
+)
+from deeplearning4j_tpu.observability.tracing import (  # noqa: F401
+    Span,
+    Tracer,
+)
+from deeplearning4j_tpu.observability.telemetry import (  # noqa: F401
+    TelemetryListener,
+)

@@ -1,0 +1,230 @@
+"""Span tracing with cross-thread parenting and Chrome trace export.
+
+A `Tracer` records host-side spans — per-step training phases
+(fetch → dispatch → device → fetch-result → checkpoint) and per-request
+serving phases (enqueue → assemble → dispatch → complete → deliver) —
+into a bounded in-memory ring buffer. Two parenting modes:
+
+  implicit   `with tracer.span("outer"): with tracer.span("inner"):`
+             nests via a thread-local stack (same thread);
+  explicit   `tracer.begin("complete", parent=dispatch_span)` parents
+             across threads — the serving pipeline's completion stage
+             and the StepWatchdog's monitor thread both attach their
+             spans to work that STARTED on another thread.
+
+`export_chrome_trace()` writes Chrome trace-event JSON (Perfetto /
+chrome://tracing loadable): "X" complete events on their real thread
+tracks, thread-name metadata, and "s"/"f" flow events binding every
+cross-thread parent→child edge so the handoff renders as an arrow, not
+a coincidence. A `jax.profiler` device trace captured in the same run
+(ProfilerListener) is registered on this timeline as a span carrying
+its trace_dir, so host spans and the device profile can be correlated.
+
+Tracing is opt-in per component (`tracer=None` default everywhere):
+the hot paths pay nothing unless a tracer is attached.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+
+class Span:
+    """One finished-or-open span. `end()` is idempotent; the span holds
+    its tracer so a handle can be resolved from any thread."""
+
+    __slots__ = ("id", "name", "cat", "tid", "thread_name", "parent_id",
+                 "args", "t0_us", "dur_us", "_tracer", "_done")
+
+    def __init__(self, tracer: "Tracer", span_id: int, name: str,
+                 cat: str, parent_id: Optional[int], t0_us: float,
+                 args: Optional[dict]):
+        self._tracer = tracer
+        self.id = span_id
+        self.name = name
+        self.cat = cat
+        self.parent_id = parent_id
+        t = threading.current_thread()
+        self.tid = t.ident or 0
+        self.thread_name = t.name
+        self.t0_us = t0_us
+        self.dur_us: Optional[float] = None
+        self.args = dict(args) if args else {}
+        self._done = False
+
+    def end(self, **extra_args) -> None:
+        if self._done:
+            return
+        self._done = True
+        if extra_args:
+            self.args.update(extra_args)
+        self._tracer._finish(self)
+
+    def to_dict(self) -> dict:
+        return {"id": self.id, "name": self.name, "cat": self.cat,
+                "tid": self.tid, "thread_name": self.thread_name,
+                "parent_id": self.parent_id, "t0_us": self.t0_us,
+                "dur_us": self.dur_us, "args": dict(self.args)}
+
+
+class Tracer:
+    """Bounded-buffer span recorder (thread-safe)."""
+
+    def __init__(self, max_spans: int = 20000):
+        self._lock = threading.Lock()
+        self._buf: deque = deque(maxlen=max(1, int(max_spans)))
+        self.max_spans = int(max_spans)
+        self._ids = itertools.count(1)
+        self._recorded = 0
+        self._t0 = time.perf_counter()
+        self._wall0 = time.time()
+        self._local = threading.local()
+
+    # ------------------------------------------------------------ clock
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def _to_us(self, perf_t: float) -> float:
+        return (perf_t - self._t0) * 1e6
+
+    # ------------------------------------------------------------ stack
+    def _stack(self) -> List[Span]:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def current(self) -> Optional[Span]:
+        """This thread's innermost open span (hand it to another thread
+        as an explicit `parent=`)."""
+        st = self._stack()
+        return st[-1] if st else None
+
+    # ---------------------------------------------------------- record
+    @staticmethod
+    def _parent_id(parent) -> Optional[int]:
+        if parent is None:
+            return None
+        return parent.id if isinstance(parent, Span) else int(parent)
+
+    def begin(self, name: str, cat: str = "host", parent=None,
+              args: Optional[dict] = None) -> Span:
+        """Open a span. `parent` may be a Span (any thread) or id; when
+        None the current thread's stack top parents it implicitly."""
+        pid = self._parent_id(parent)
+        if pid is None:
+            cur = self.current()
+            pid = cur.id if cur is not None else None
+        return Span(self, next(self._ids), name, cat, pid,
+                    self._now_us(), args)
+
+    def _finish(self, span: Span) -> None:
+        if span.dur_us is None:
+            span.dur_us = max(0.0, self._now_us() - span.t0_us)
+        with self._lock:
+            self._buf.append(span)
+            self._recorded += 1
+
+    @contextmanager
+    def span(self, name: str, cat: str = "host", parent=None,
+             args: Optional[dict] = None):
+        sp = self.begin(name, cat=cat, parent=parent, args=args)
+        st = self._stack()
+        st.append(sp)
+        try:
+            yield sp
+        finally:
+            if st and st[-1] is sp:
+                st.pop()
+            sp.end()
+
+    def record(self, name: str, start_perf: float, end_perf: float,
+               cat: str = "host", parent=None,
+               args: Optional[dict] = None) -> Span:
+        """Record an already-measured interval (perf_counter values) —
+        the fit loops already time their phases, so the span rides the
+        same two clock reads."""
+        sp = Span(self, next(self._ids), name, cat,
+                  self._parent_id(parent), self._to_us(start_perf), args)
+        sp.dur_us = max(0.0, (end_perf - start_perf) * 1e6)
+        sp._done = True
+        with self._lock:
+            self._buf.append(sp)
+            self._recorded += 1
+        return sp
+
+    def instant(self, name: str, cat: str = "host", parent=None,
+                args: Optional[dict] = None) -> Span:
+        sp = self.begin(name, cat=cat, parent=parent, args=args)
+        sp.dur_us = 0.0
+        sp._done = True
+        with self._lock:
+            self._buf.append(sp)
+            self._recorded += 1
+        return sp
+
+    # ------------------------------------------------------------ reads
+    def spans(self) -> List[dict]:
+        with self._lock:
+            return [s.to_dict() for s in self._buf]
+
+    def stats(self) -> dict:
+        with self._lock:
+            buffered = len(self._buf)
+            recorded = self._recorded
+        return {"recorded": recorded, "buffered": buffered,
+                "dropped": recorded - buffered,
+                "max_spans": self.max_spans}
+
+    # ----------------------------------------------------------- export
+    def export_chrome_trace(self, path: Optional[str] = None) -> dict:
+        """Chrome trace-event JSON (Perfetto-loadable). Every span is an
+        "X" complete event on its real thread; cross-thread parent→child
+        edges additionally emit an "s"/"f" flow pair so the handoff is
+        drawn as an arrow between tracks."""
+        pid = os.getpid()
+        with self._lock:
+            spans = list(self._buf)
+        by_id: Dict[int, Span] = {s.id: s for s in spans}
+        events: List[dict] = []
+        seen_tids: Dict[int, str] = {}
+        for s in spans:
+            seen_tids.setdefault(s.tid, s.thread_name)
+        for tid, tname in seen_tids.items():
+            events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                           "tid": tid, "args": {"name": tname}})
+        for s in spans:
+            args = dict(s.args)
+            if s.parent_id is not None:
+                args["parent_id"] = s.parent_id
+            events.append({
+                "ph": "X", "name": s.name, "cat": s.cat, "pid": pid,
+                "tid": s.tid, "ts": round(s.t0_us, 3),
+                "dur": round(s.dur_us or 0.0, 3), "args": args})
+            parent = (by_id.get(s.parent_id)
+                      if s.parent_id is not None else None)
+            if parent is not None and parent.tid != s.tid:
+                # flow: start at the parent, finish (enclosing-slice
+                # binding) at the child — the cross-thread arrow
+                events.append({
+                    "ph": "s", "id": s.id, "name": "handoff",
+                    "cat": "flow", "pid": pid, "tid": parent.tid,
+                    "ts": round(parent.t0_us, 3)})
+                events.append({
+                    "ph": "f", "bp": "e", "id": s.id, "name": "handoff",
+                    "cat": "flow", "pid": pid, "tid": s.tid,
+                    "ts": round(s.t0_us, 3)})
+        doc = {"traceEvents": events, "displayTimeUnit": "ms",
+               "otherData": {"unix_time_origin_s": self._wall0,
+                             "exporter": "deeplearning4j_tpu"}}
+        if path:
+            with open(path, "w") as f:
+                json.dump(doc, f)
+        return doc

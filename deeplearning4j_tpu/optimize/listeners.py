@@ -309,17 +309,53 @@ class ProfilerListener:
     [start_iteration, start_iteration + num_iterations) — the op-level
     tracer SURVEY §5.1 maps to (the reference delegates to the ND4J
     profiler). View the trace with TensorBoard's profile plugin or
-    xprof; PERF.md documents the xplane aggregation recipe."""
+    xprof; PERF.md documents the xplane aggregation recipe.
+
+    `stop()` is idempotent and safe from overlapping paths — an
+    epoch-end flush racing an abort/`__del__` teardown must not call
+    `jax.profiler.stop_trace()` twice (the second call raises inside
+    jax and used to mask the original error). `trace_dir` surfaces
+    through `TrainingMaster.training_stats()["profiler"]`.
+
+    Pass `tracer=` (observability.Tracer) to register the device-trace
+    window on the shared host-span timeline: the exported Chrome trace
+    then carries a "jax_device_trace" span whose args point at the
+    xplane directory, so host spans and the device profile correlate."""
 
     def __init__(self, log_dir: str, start_iteration: int = 10,
-                 num_iterations: int = 5, log=None):
+                 num_iterations: int = 5, log=None, tracer=None):
         self.log_dir = log_dir
         self.start = start_iteration
         self.stop_at = start_iteration + num_iterations
         self.log = log or (lambda msg: logger.info(msg))
+        self.tracer = tracer
         self._active = False
         self._done = False
+        self._span = None
         self.trace_dir = None
+
+    def stop(self):
+        """Finish an active trace. Idempotent: overlapping epoch-end /
+        abort / __del__ paths may all call it; only the first does the
+        (unrepeatable) jax.profiler.stop_trace."""
+        if not self._active:
+            return
+        self._active = False   # flip FIRST: re-entry becomes a no-op
+        self._done = True
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:   # noqa: BLE001 - a torn profiler session
+            logger.exception("ProfilerListener: stop_trace failed")
+        self.trace_dir = self.log_dir
+        if self._span is not None:
+            try:
+                self._span.end(trace_dir=self.log_dir)
+            except Exception:   # noqa: BLE001 - telemetry best-effort
+                pass
+            self._span = None
+        self.log(f"profiler trace written to {self.log_dir}")
 
     def iteration_done(self, model, iteration: int):
         import jax
@@ -329,21 +365,30 @@ class ProfilerListener:
             # TBPTT segments)
             jax.profiler.start_trace(self.log_dir)
             self._active = True
+            if self.tracer is not None:
+                try:
+                    self._span = self.tracer.begin(
+                        "jax_device_trace", cat="device",
+                        args={"log_dir": self.log_dir})
+                except Exception:   # noqa: BLE001 - telemetry best-effort
+                    self._span = None
         elif self._active and iteration >= self.stop_at:
             # force pending device work into the traced window
             if model.score() is not None:
                 float(model.score())
-            jax.profiler.stop_trace()
-            self._active = False
-            self._done = True
-            self.trace_dir = self.log_dir
-            self.log(f"profiler trace written to {self.log_dir}")
+            self.stop()
+
+    def on_epoch_end(self, model):
+        """Epoch-end flush: a trace still open when the epoch (or an
+        aborted fit calling the epoch-end hooks) finishes is closed
+        here instead of leaking into teardown."""
+        if self._active:
+            if model is not None and model.score() is not None:
+                float(model.score())
+            self.stop()
 
     def __del__(self):
-        if getattr(self, "_active", False):
-            try:
-                import jax
-
-                jax.profiler.stop_trace()
-            except Exception:
-                pass
+        try:
+            self.stop()
+        except Exception:   # noqa: BLE001 - interpreter teardown
+            pass

@@ -55,6 +55,8 @@ from typing import Dict, List, Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.observability import metrics as _obs
+from deeplearning4j_tpu.observability.metrics import COUNT_BUCKETS
 from deeplearning4j_tpu.resilience.errors import (
     DeadlineExceededError,
     InferenceUnavailableError,
@@ -82,7 +84,7 @@ class _Pending:
     only ever called from the single completion stage, so it needs no
     lock of its own."""
 
-    __slots__ = ("x", "event", "result", "_left", "_out")
+    __slots__ = ("x", "event", "result", "_left", "_out", "span")
 
     def __init__(self, x):
         self.x = x
@@ -90,11 +92,19 @@ class _Pending:
         self.result = None
         self._left = x.shape[0]
         self._out = None
+        self.span = None   # open request span (tracer attached only)
 
     def resolve(self, result):
         if not self.event.is_set():
             self.result = result
             self.event.set()
+            if self.span is not None:
+                try:
+                    self.span.end(
+                        error=type(result).__name__
+                        if isinstance(result, Exception) else None)
+                except Exception:   # noqa: BLE001 - telemetry best-effort
+                    pass
 
     def deliver(self, start: int, rows: np.ndarray) -> bool:
         """Returns True when this delivery completed the request."""
@@ -134,14 +144,21 @@ class ParallelInference:
                  warmup: bool = True,
                  adaptive_wait: bool = True,
                  min_wait_ms: float = 0.0,
-                 warmup_inputs=None):
+                 warmup_inputs=None,
+                 tracer=None):
         """`warmup_inputs`: per-example input shapes for nets whose
         shape is underivable from the conf (multi-input
         ComputationGraphs, stub nets) — a sequence with one entry per
         network input, each either a shape tuple (no batch dim) or an
         example array whose leading dim is the batch. Without it such
-        nets skip warmup (warned once per process)."""
+        nets skip warmup (warned once per process).
+
+        `tracer` (observability.Tracer, optional): records per-request
+        spans (enqueue→…→deliver) and per-batch spans on BOTH pipeline
+        stages, explicitly parented across the assembler / completion
+        threads. None (default) costs the hot path nothing."""
         self.net = net
+        self.tracer = tracer
         self.warmup_inputs = warmup_inputs
         self.mode = inference_mode
         self.batch_limit = batch_limit
@@ -321,9 +338,18 @@ class ParallelInference:
                 return np.asarray(self.net.output(x))
         self._check_available()
         p = _Pending(x)
+        if self.tracer is not None:
+            try:
+                p.span = self.tracer.begin(
+                    "request", cat="serving",
+                    args={"rows": int(x.shape[0])})
+            except Exception:   # noqa: BLE001 - telemetry best-effort
+                p.span = None
         try:
             self._queue.put_nowait(p)
         except queue.Full:
+            if p.span is not None:
+                p.span.end(error="OverloadedError")
             raise OverloadedError(
                 f"inference queue full ({self._queue.maxsize} waiting); "
                 "retry later") from None
@@ -388,7 +414,7 @@ class ParallelInference:
             return
         while True:
             try:
-                _, slots, key, buf = self._inflight.get_nowait()
+                _, slots, key, buf, _ = self._inflight.get_nowait()
             except queue.Empty:
                 return
             self._inflight_n -= 1
@@ -467,6 +493,7 @@ class ParallelInference:
             rows += take
             if take < first.x.shape[0]:
                 self._carry = (first, take)
+                _obs.count("dl4j_serving_bucket_splits_total")
                 return slots, rows
         wait_s = self._current_wait_s()
         t0 = time.monotonic()
@@ -499,6 +526,7 @@ class ParallelInference:
             rows += take
             if take < p.x.shape[0]:
                 self._carry = (p, take)
+                _obs.count("dl4j_serving_bucket_splits_total")
                 break
         return slots, rows
 
@@ -529,11 +557,25 @@ class ParallelInference:
                 slots, rows = self._collect()
                 if not slots:
                     continue
+                # assembler-stage span: explicitly parented to the
+                # FIRST request's span — the request started on a
+                # caller thread, this stage runs on the batcher thread
+                dspan = None
+                if self.tracer is not None:
+                    try:
+                        dspan = self.tracer.begin(
+                            "assemble_dispatch", cat="serving",
+                            parent=slots[0][0].span,
+                            args={"rows": rows, "slots": len(slots)})
+                    except Exception:   # noqa: BLE001 - telemetry
+                        dspan = None
                 try:
                     key, buf = self._assemble(slots, rows)
                 except Exception as e:   # per-batch: propagate to callers
                     for p, _, _ in slots:
                         p.resolve(e)
+                    if dspan is not None:
+                        dspan.end(error=type(e).__name__)
                     continue
                 try:
                     with self._lock:
@@ -545,13 +587,23 @@ class ParallelInference:
                     for p, _, _ in slots:
                         p.resolve(e)
                     self._put_buffer(key, buf)
+                    if dspan is not None:
+                        dspan.end(error=type(e).__name__)
                     continue
                 self._batches_dispatched += 1
+                _obs.count_observe(
+                    "dl4j_serving_batches_total",
+                    "dl4j_serving_batch_occupancy", rows,
+                    buckets=COUNT_BUCKETS)
+                _obs.set_gauge("dl4j_serving_queue_depth",
+                               self._queue.qsize())
+                if dspan is not None:
+                    dspan.end()
                 self._adapt_wait(rows)
                 if self._completer is None:
-                    self._complete_batch(out, slots, key, buf)
+                    self._complete_batch(out, slots, key, buf, dspan)
                 else:
-                    self._submit_inflight((out, slots, key, buf))
+                    self._submit_inflight((out, slots, key, buf, dspan))
         except BaseException as e:   # noqa: BLE001 - loop-level death
             # assembler death is a degradation event, not a hang: record
             # it (flips `healthy` and /healthz), then fail every waiter
@@ -570,7 +622,7 @@ class ParallelInference:
             if self._stop.is_set() or self._failure is not None or (
                     self._completer is not None
                     and not self._completer.is_alive()):
-                _, slots, key, buf = item
+                _, slots, key, buf, _ = item
                 err = self._unavailable_error() \
                     if not self._stop.is_set() else ShutdownError(
                         "ParallelInference shut down with requests "
@@ -585,17 +637,32 @@ class ParallelInference:
                     self._slot_free.wait(timeout=0.05)
                 continue
             self._inflight_n += 1
+            _obs.set_gauge("dl4j_serving_inflight_batches",
+                           self._inflight_n)
             self._inflight.put(item)
             return
 
     # ------------------------------------------------------- completion
-    def _complete_batch(self, out, slots: List[_Slot], key, buf):
+    def _complete_batch(self, out, slots: List[_Slot], key, buf,
+                        dspan=None):
+        # completion-stage span: parented to the assembler's dispatch
+        # span — a cross-THREAD edge when the completer is running
+        cspan = None
+        if self.tracer is not None and dspan is not None:
+            try:
+                cspan = self.tracer.begin(
+                    "complete_deliver", cat="serving", parent=dspan,
+                    args={"slots": len(slots)})
+            except Exception:   # noqa: BLE001 - telemetry best-effort
+                cspan = None
         try:
             host = np.asarray(out)   # host fetch: blocks until computed
         except Exception as e:   # per-batch: propagate to callers
             for p, _, _ in slots:
                 p.resolve(e)
             self._put_buffer(key, buf)
+            if cspan is not None:
+                cspan.end(error=type(e).__name__)
             return
         if np.may_share_memory(host, buf):
             # jnp.asarray can zero-copy-alias the staging buffer on CPU
@@ -608,6 +675,8 @@ class ParallelInference:
             if p.deliver(src, host[ofs:ofs + n]):
                 self._requests_completed += 1
             ofs += n
+        if cspan is not None:
+            cspan.end()
 
     def _completion_loop(self):
         try:
@@ -623,6 +692,8 @@ class ParallelInference:
                     self._complete_batch(*item)
                 finally:
                     self._inflight_n -= 1
+                    _obs.set_gauge("dl4j_serving_inflight_batches",
+                                   self._inflight_n)
                     self._slot_free.set()
         except BaseException as e:   # noqa: BLE001 - loop-level death
             self._failure = e

@@ -12,7 +12,11 @@ Routes:
   POST /predict  {"inputs": [[...], ...]}          -> {"outputs": [...]}
   POST /predict  {"inputs": ..., "decode_top": 5}  -> adds "decoded"
                  (requires an ImageNetLabels source; zoo/util/imagenet)
-  GET  /status   -> model + queue facts
+  GET  /status   -> model + queue + telemetry facts (uptime_s,
+                 monotonic request/error counters from the registry)
+  GET  /metrics  -> Prometheus text exposition of the global
+                 MetricsRegistry (training, serving, checkpoint, and
+                 resilience domains — one scrape covers the process)
   GET  /healthz  -> liveness: 200 while the batcher is alive, 503 after
                  it dies or the server shuts down
   GET  /readyz   -> readiness: 200 only while accepting traffic
@@ -32,10 +36,16 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.observability import metrics as _obs
+from deeplearning4j_tpu.observability.metrics import (
+    get_registry,
+    parse_prometheus,
+)
 from deeplearning4j_tpu.parallel.inference import (
     InferenceMode,
     ParallelInference,
@@ -72,7 +82,8 @@ class ModelServer:
                  batch_limit: int = 32, labels=None,
                  output_activation: bool = True,
                  pipeline_depth: int = 2, warmup: bool = True,
-                 max_wait_ms: float = 2.0, adaptive_wait: bool = True):
+                 max_wait_ms: float = 2.0, adaptive_wait: bool = True,
+                 tracer=None):
         self._owns_pi = not isinstance(net, ParallelInference)
         self.pi = (net if not self._owns_pi
                    else ParallelInference(net, inference_mode,
@@ -80,7 +91,10 @@ class ModelServer:
                                           pipeline_depth=pipeline_depth,
                                           warmup=warmup,
                                           max_wait_ms=max_wait_ms,
-                                          adaptive_wait=adaptive_wait))
+                                          adaptive_wait=adaptive_wait,
+                                          tracer=tracer))
+        self.tracer = tracer if tracer is not None \
+            else getattr(self.pi, "tracer", None)
         self.labels = labels
         self.host = host
         self.port = port
@@ -89,6 +103,7 @@ class ModelServer:
         self._served = 0
         self._served_lock = threading.Lock()
         self._ready = False
+        self._t0 = time.monotonic()
 
     # ------------------------------------------------------------ handlers
     def _handle_predict(self, req: dict) -> dict:
@@ -131,7 +146,33 @@ class ModelServer:
         trace = self.pi.trace_stats()
         facts["trace_counts"] = trace.get("trace_counts", {})
         facts["total_traces"] = trace.get("total_traces", 0)
+        # telemetry facts (observability/): uptime + the registry's
+        # monotonic request/error counters (process-wide, survive
+        # across this server's construction), plus span-buffer facts
+        # when a tracer is attached
+        reg = get_registry()
+        facts["uptime_s"] = round(time.monotonic() - self._t0, 3)
+        facts["requests_total"] = int(reg.counter_value(
+            "dl4j_serving_requests_total"))
+        facts["errors_total"] = int(reg.counter_value(
+            "dl4j_serving_errors_total"))
+        facts["telemetry"] = {
+            "enabled": _obs.telemetry_enabled(),
+            "dropped_emissions": reg.dropped,
+            "spans": (self.tracer.stats()
+                      if self.tracer is not None else None),
+        }
         return facts
+
+    def _metrics_text(self) -> str:
+        """The GET /metrics body: refresh the pull-style gauges from
+        the live front-end, then render the whole registry."""
+        _obs.set_gauge("dl4j_serving_queue_depth",
+                       self.pi.queue_depth())
+        trace = self.pi.trace_stats()
+        _obs.set_gauge("dl4j_jit_traces_total",
+                       trace.get("total_traces", 0))
+        return get_registry().prometheus_text()
 
     # --------------------------------------------------------------- start
     def start(self) -> "ModelServer":
@@ -151,7 +192,17 @@ class ModelServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _send_text(self, code, text, content_type):
+                body = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def _send_error(self, code, exc, headers=()):
+                _obs.count("dl4j_serving_errors_total",
+                           labels={"code": str(code)})
                 self._send(code, {"error": str(exc),
                                   "error_class": type(exc).__name__},
                            headers)
@@ -160,6 +211,11 @@ class ModelServer:
                 path = self.path.rstrip("/")
                 if path == "/status":
                     self._send(200, server._status_facts())
+                elif path == "/metrics":
+                    # Prometheus text exposition (scrape target)
+                    self._send_text(
+                        200, server._metrics_text(),
+                        "text/plain; version=0.0.4; charset=utf-8")
                 elif path == "/healthz":
                     if server.pi.healthy:
                         self._send(200, {"status": "ok"})
@@ -183,6 +239,8 @@ class ModelServer:
                     self._send(404, {"error": f"no route {self.path}",
                                      "error_class": "NotFound"})
                     return
+                _obs.count("dl4j_serving_requests_total")
+                t0 = time.perf_counter()
                 try:
                     _fire("serve.request")
                     n = int(self.headers.get("Content-Length", 0))
@@ -193,7 +251,10 @@ class ModelServer:
                             from None
                     if not isinstance(req, dict):
                         raise _ClientError("body must be a JSON object")
-                    self._send(200, server._handle_predict(req))
+                    resp = server._handle_predict(req)
+                    _obs.observe("dl4j_serving_request_seconds",
+                                 time.perf_counter() - t0)
+                    self._send(200, resp)
                 except _ClientError as e:
                     self._send_error(400, e)
                 except _UNAVAILABLE as e:
@@ -344,6 +405,23 @@ class ModelClient:
 
     def status(self) -> dict:
         return self._request("/status")
+
+    def metrics(self) -> dict:
+        """GET /metrics parsed into {sample_name[{labels}]: value} —
+        the test-friendly view of the Prometheus exposition (raw text
+        via `metrics_text()`)."""
+        return parse_prometheus(self.metrics_text())
+
+    def metrics_text(self) -> str:
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(self.url + "/metrics")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return r.read().decode()
+        except urllib.error.HTTPError as e:
+            raise self._serving_error(e) from None
 
     def healthz(self) -> bool:
         """True iff the server reports itself live (no retry — a probe

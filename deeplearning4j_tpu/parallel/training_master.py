@@ -30,11 +30,13 @@ import json
 import logging
 import os
 import re
+import time
 from functools import partial
 from typing import Callable, Optional, Tuple
 
 import numpy as np
 
+from deeplearning4j_tpu.observability import metrics as _obs
 from deeplearning4j_tpu.resilience import checkpoint_integrity as _ci
 from deeplearning4j_tpu.resilience.errors import (
     FaultInjectedError,
@@ -77,7 +79,8 @@ class TrainingMaster:
                  data_retry: Optional[Retry] = None,
                  skip_bad_batches: bool = False,
                  supervisor: Optional[Supervisor] = None,
-                 guard_inner_steps: bool = False):
+                 guard_inner_steps: bool = False,
+                 tracer=None):
         """`averaging_frequency=k > 1` runs k-step local SGD between
         parameter rendezvous — each dp shard trains privately for k
         steps, then params (+ updater state) are averaged. This is the
@@ -145,6 +148,16 @@ class TrainingMaster:
                                 "preemptions": 0}
         self._staged = False
         self._local_step = None
+        # observability (observability/): a Tracer records per-step
+        # spans (fetch/dispatch/sync/checkpoint) on one exportable
+        # timeline; registry metrics are always emitted (guarded,
+        # near-zero cost) regardless. The per-step counters/histograms
+        # batch through a StepAccumulator (flushed every 32 steps and
+        # at fit end) so the hot loop pays container appends, not
+        # registry locks.
+        self.tracer = tracer
+        self._step_span = None
+        self._obs_acc = _obs.StepAccumulator()
 
     # ------------------------------------------------------------ dist init
     @staticmethod
@@ -245,9 +258,16 @@ class TrainingMaster:
         `skip_bad_batches` make a flaky batch_fn (the `data.next`
         fault point) survivable. Run the whole fit under
         `Supervisor.run` to also survive crashes/hangs/preemptions via
-        checkpoint resume."""
-        import time
+        checkpoint resume.
 
+        Telemetry (observability/): every loop iteration lands in the
+        global MetricsRegistry (`dl4j_train_steps_total` counts
+        ATTEMPTED steps, including skipped ones;
+        `dl4j_train_step_seconds` their wall time); with a `tracer`
+        attached each step records a parent span with
+        fetch/dispatch/sync/checkpoint children, and the StepWatchdog's
+        monitor thread parents its hang events to the current step
+        span."""
         self._stage_net()
         net = self.net
         guard = self.guard
@@ -271,6 +291,9 @@ class TrainingMaster:
             self.preemption.install()
         if wd is not None:
             wd.start()
+            # hang events recorded by the monitor thread attach to the
+            # training thread's current step span (cross-thread parent)
+            wd.tracer = self.tracer
         try:
             if self.averaging_frequency > 1:
                 return self._fit_local_sgd(batch_fn, num_steps,
@@ -279,6 +302,7 @@ class TrainingMaster:
             is_graph = hasattr(net.conf, "network_inputs")
             is_tbptt = getattr(net.conf, "backprop_type", None) \
                 == "truncated_bptt"
+            tr = self.tracer
             with self.mesh:
                 step = start_step
                 while step < num_steps:
@@ -286,89 +310,127 @@ class TrainingMaster:
                         step += 1   # rollback replay: skip the poisoned
                         continue    # data window, train nothing on it
                     self._check_preemption(step)
-                    _fire("train.step")
-                    _fire("train.hang")
-                    fire_hang_hard()
+                    step_t0 = time.perf_counter()
+                    sp = (tr.begin("train_step", cat="train",
+                                   args={"step": step})
+                          if tr is not None else None)
+                    self._step_span = sp
                     if wd is not None:
-                        wd.beat("dispatch", step=step)
-                    t0 = time.perf_counter()
-                    batch = self._next_batch(batch_fn, step)
-                    if batch is None:       # bad batch skipped by policy
-                        step += 1
-                        continue
-                    x, y = self._global_batch(
-                        self._maybe_poison(batch[0]), batch[1])
-                    t1 = time.perf_counter()
-                    done = step + 1
-                    ckpt_due = bool(
-                        self.checkpoint_dir and self.checkpoint_every
-                        and done % self.checkpoint_every == 0)
-                    # a checkpoint must never publish non-finite state:
-                    # force a check on checkpoint steps even when the
-                    # sampling cadence would skip them
-                    check_now = guard is not None and (
-                        guard.should_check(step)
-                        or (ckpt_due and guard.check_every > 0))
-                    snap = (guard.snapshot(net)
-                            if check_now and guard.policy == "skip_step"
-                            else None)
-                    chunked = is_tbptt and getattr(x, "ndim", 0) == 3
-                    if is_graph:
-                        name = net.conf.network_inputs[0]
-                        if chunked:
-                            net._fit_tbptt({name: x}, [y], None, None)
-                        else:
-                            net._train_step({name: x}, [y])
-                    elif chunked:
-                        net._fit_tbptt(x, y, None, None)
-                    else:
-                        net._train_step(x, y)
-                    if wd is not None:
-                        wd.beat("fetch", step=step)
-                    if check_now:
-                        verdict = guard.post_step(net)
-                        if verdict != "ok":
-                            if guard.policy == "skip_step":
-                                guard.restore(net, snap)
-                                guard.note_skip()
-                                logger.warning(
-                                    "guard: %s at step %d — step "
-                                    "skipped, state restored",
-                                    verdict, step)
-                                step += 1
-                                continue
-                            if guard.policy == "rollback":
-                                step = self._rollback(step, verdict)
-                                continue
-                            raise NonFiniteLossError(
-                                f"{verdict} training state at step "
-                                f"{step} (policy=abort)")
-                    if collect_training_stats:
-                        # host fetch = true step barrier for honest
-                        # timing
-                        float(net.score())
-                    t2 = time.perf_counter()
-                    for listener in net.listeners:
-                        listener.iteration_done(net, net.iteration)
-                    t3 = time.perf_counter()
-                    if ckpt_due:
-                        self.save_checkpoint(done)
-                    if collect_training_stats:
-                        self._stats.append({
-                            "step": step,
-                            "data_ms": (t1 - t0) * 1e3,
-                            "fit_ms": (t2 - t1) * 1e3,
-                            "listener_ms": (t3 - t2) * 1e3,
-                            "checkpoint_ms":
-                                (time.perf_counter() - t3) * 1e3,
-                        })
-                    step += 1
+                        wd.trace_parent = sp
+                    try:
+                        step = self._fit_one_step(
+                            batch_fn, step, is_graph, is_tbptt,
+                            collect_training_stats)
+                    finally:
+                        self._obs_acc.count_observe(
+                            "dl4j_train_steps_total",
+                            "dl4j_train_step_seconds",
+                            time.perf_counter() - step_t0)
+                        self._step_span = None
+                        if sp is not None:
+                            sp.end()
         finally:
+            self._obs_acc.flush()
             if wd is not None:
                 wd.stop()
             if self.preemption is not None:
                 self.preemption.uninstall()
         return self
+
+    def _fit_one_step(self, batch_fn, step, is_graph, is_tbptt,
+                      collect_training_stats) -> int:
+        """One attempted global step (extracted so fit() wraps it in
+        span + metric accounting): returns the step index to continue
+        from — step+1 normally and on skips, the restored step after a
+        rollback."""
+        net = self.net
+        guard = self.guard
+        wd = self.watchdog
+        tr = self.tracer
+        sp = self._step_span
+        _fire("train.step")
+        _fire("train.hang")
+        fire_hang_hard()
+        if wd is not None:
+            wd.beat("dispatch", step=step)
+        t0 = time.perf_counter()
+        batch = self._next_batch(batch_fn, step)
+        if batch is None:       # bad batch skipped by policy
+            return step + 1
+        x, y = self._global_batch(
+            self._maybe_poison(batch[0]), batch[1])
+        t1 = time.perf_counter()
+        if tr is not None:
+            tr.record("fetch_and_stage", t0, t1, cat="train", parent=sp)
+        done = step + 1
+        ckpt_due = bool(
+            self.checkpoint_dir and self.checkpoint_every
+            and done % self.checkpoint_every == 0)
+        # a checkpoint must never publish non-finite state: force a
+        # check on checkpoint steps even when the sampling cadence
+        # would skip them
+        check_now = guard is not None and (
+            guard.should_check(step)
+            or (ckpt_due and guard.check_every > 0))
+        snap = (guard.snapshot(net)
+                if check_now and guard.policy == "skip_step"
+                else None)
+        chunked = is_tbptt and getattr(x, "ndim", 0) == 3
+        if is_graph:
+            name = net.conf.network_inputs[0]
+            if chunked:
+                net._fit_tbptt({name: x}, [y], None, None)
+            else:
+                net._train_step({name: x}, [y])
+        elif chunked:
+            net._fit_tbptt(x, y, None, None)
+        else:
+            net._train_step(x, y)
+        t_disp = time.perf_counter()
+        if tr is not None:
+            tr.record("dispatch", t1, t_disp, cat="train", parent=sp)
+        if wd is not None:
+            wd.beat("fetch", step=step)
+        if check_now:
+            verdict = guard.post_step(net)
+            if verdict != "ok":
+                if guard.policy == "skip_step":
+                    guard.restore(net, snap)
+                    guard.note_skip()
+                    logger.warning(
+                        "guard: %s at step %d — step "
+                        "skipped, state restored",
+                        verdict, step)
+                    return step + 1
+                if guard.policy == "rollback":
+                    return self._rollback(step, verdict)
+                raise NonFiniteLossError(
+                    f"{verdict} training state at step "
+                    f"{step} (policy=abort)")
+        if collect_training_stats:
+            # host fetch = true step barrier for honest timing
+            float(net.score())
+        t2 = time.perf_counter()
+        if tr is not None and (check_now or collect_training_stats):
+            # the guard check / stats fetch forced a host sync — this
+            # span is the device+fetch-result phase made visible
+            tr.record("device_sync", t_disp, t2, cat="train",
+                      parent=sp)
+        for listener in net.listeners:
+            listener.iteration_done(net, net.iteration)
+        t3 = time.perf_counter()
+        if ckpt_due:
+            self.save_checkpoint(done)
+        if collect_training_stats:
+            self._stats.append({
+                "step": step,
+                "data_ms": (t1 - t0) * 1e3,
+                "fit_ms": (t2 - t1) * 1e3,
+                "listener_ms": (t3 - t2) * 1e3,
+                "checkpoint_ms":
+                    (time.perf_counter() - t3) * 1e3,
+            })
+        return step + 1
 
     # ------------------------------------------------------- self-healing
     def _next_batch(self, batch_fn, step):
@@ -379,19 +441,25 @@ class TrainingMaster:
             _fire("data.next")
             return batch_fn(step)
 
+        t_fetch = time.perf_counter()
         try:
             if self.data_retry is not None:
-                return self.data_retry.call(get)
-            return get()
+                out = self.data_retry.call(get)
+            else:
+                out = get()
         except (StepHangError, PreemptedError):
             raise          # escalations, not data failures
         except Exception:
             if self.skip_bad_batches:
                 self._resil_counters["data_skipped_steps"] += 1
+                _obs.count("dl4j_train_data_skipped_steps_total")
                 logger.warning("data.next failed at step %d — step "
                                "skipped (skip_bad_batches)", step)
                 return None
             raise
+        self._obs_acc.observe("dl4j_train_data_wait_seconds",
+                              time.perf_counter() - t_fetch)
+        return out
 
     def _maybe_poison(self, x):
         """`train.grad_nonfinite` chaos hook: a triggered fire is
@@ -422,6 +490,7 @@ class TrainingMaster:
         if not requested:
             return
         self._resil_counters["preemptions"] += 1
+        _obs.count("dl4j_train_preemptions_total")
         if self.preemption is not None:
             self.preemption.counters["preemptions"] += 1
             self.preemption.clear()   # a supervised restart may resume
@@ -525,6 +594,8 @@ class TrainingMaster:
                     if bad:
                         guard.counters["checks"] += 1
                         guard.counters["nonfinite"] += 1
+                        _obs.count("dl4j_train_guard_checks_total")
+                        _obs.count("dl4j_train_guard_nonfinite_total")
                         if guard.policy == "abort":
                             raise NonFiniteLossError(
                                 f"non-finite loss at inner step(s) "
@@ -572,6 +643,17 @@ class TrainingMaster:
                 if collect_training_stats:
                     float(net.score())
                 t2 = time.perf_counter()
+                # group telemetry: steps_total counts the inner steps
+                # actually trained; step_seconds stays in per-step
+                # units (group wall time averaged over its steps)
+                self._obs_acc.count_observe(
+                    "dl4j_train_steps_total", "dl4j_train_step_seconds",
+                    (t2 - t0) / max(1, len(abs_steps)),
+                    n=len(abs_steps))
+                if self.tracer is not None:
+                    self.tracer.record(
+                        "train_group", t0, t2, cat="train",
+                        args={"step": step, "steps": len(abs_steps)})
                 prev = step
                 step += span
                 # checkpoint when the group CROSSES a cadence boundary
@@ -600,15 +682,30 @@ class TrainingMaster:
         wire = (self._local_step.wire_stats()
                 if self._local_step is not None else None)
         resil = self.resilience_stats()
+        prof = self._profiler_stats()
         if not stats:
             return {"steps": [], "summary": {}, "wire": wire,
-                    "resilience": resil}
+                    "resilience": resil, "profiler": prof}
         summary = {
             k: float(np.mean([s[k] for s in stats]))
             for k in ("data_ms", "fit_ms", "listener_ms", "checkpoint_ms")
         }
         return {"steps": stats, "summary": summary, "wire": wire,
-                "resilience": resil}
+                "resilience": resil, "profiler": prof}
+
+    def _profiler_stats(self):
+        """Surface an attached ProfilerListener's device-trace facts
+        (satellite: trace_dir was previously only reachable by digging
+        the listener out of net.listeners by hand)."""
+        for listener in getattr(self.net, "listeners", []):
+            if hasattr(listener, "trace_dir") \
+                    and hasattr(listener, "log_dir"):
+                return {"trace_dir": listener.trace_dir,
+                        "log_dir": listener.log_dir,
+                        "active": bool(getattr(listener, "_active",
+                                               False)),
+                        "done": bool(getattr(listener, "_done", False))}
+        return None
 
     def resilience_stats(self):
         """Guard / watchdog / preemption / restart counters (None when
@@ -738,6 +835,22 @@ class TrainingMaster:
         return np.asarray(a)
 
     def save_checkpoint(self, step: int):
+        """Timed wrapper around the format-specific save: checkpoint
+        write latency + count land in the registry, and with a tracer
+        attached the save records a span parented to the current step
+        span."""
+        t0 = time.perf_counter()
+        result = self._save_checkpoint_impl(step)
+        t1 = time.perf_counter()
+        _obs.count("dl4j_checkpoint_writes_total")
+        _obs.observe("dl4j_checkpoint_write_seconds", t1 - t0)
+        if self.tracer is not None:
+            self.tracer.record("checkpoint_save", t0, t1,
+                               cat="checkpoint", parent=self._step_span,
+                               args={"step": step})
+        return result
+
+    def _save_checkpoint_impl(self, step: int):
         """Write {params, updater state, states, step, rng}.
 
         format="npz": process 0 gathers everything to host and writes
@@ -830,6 +943,7 @@ class TrainingMaster:
         import jax
         import orbax.checkpoint as ocp
 
+        t_restore = time.perf_counter()
         net = self.net
         if net.params is None:
             net.init()
@@ -851,6 +965,9 @@ class TrainingMaster:
             net.iteration = int(np.asarray(data["iteration"]))
             net.epoch = int(np.asarray(data["epoch"]))
         self._staged = True
+        _obs.count("dl4j_checkpoint_restores_total")
+        _obs.observe("dl4j_checkpoint_restore_seconds",
+                     time.perf_counter() - t_restore)
         return meta["step"]
 
     def _orbax_steps(self):
@@ -948,6 +1065,7 @@ class TrainingMaster:
         return self._restore_npz(step, self._read_latest_meta())
 
     def _restore_npz(self, step: int, meta) -> int:
+        t_restore = time.perf_counter()
         data = self._ckpt_retry.call(np.load, self._ckpt_path(step))
         import jax
 
@@ -974,6 +1092,9 @@ class TrainingMaster:
             net.iteration = meta["iteration"]
             net.epoch = meta["epoch"]
         self._staged = True
+        _obs.count("dl4j_checkpoint_restores_total")
+        _obs.observe("dl4j_checkpoint_restore_seconds",
+                     time.perf_counter() - t_restore)
         return step
 
     def list_checkpoints(self):

@@ -25,6 +25,7 @@ sharding.py rules — a capability with no reference counterpart.
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Any, Optional
 
@@ -33,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu.observability import metrics as _obs
 from deeplearning4j_tpu.parallel.mesh import make_mesh
 from deeplearning4j_tpu.parallel.sharding import (
     param_shardings,
@@ -118,6 +120,9 @@ class ParallelWrapper:
         self.prefetch_buffer = prefetch_buffer
         self._sharded = False
         self._local_step = None
+        # per-step telemetry batches through an accumulator (flushed
+        # every 32 steps + at fit end) — appends, not registry locks
+        self._obs_acc = _obs.StepAccumulator()
 
     # ------------------------------------------------------------------
     def _ensure_sharded(self):
@@ -172,7 +177,14 @@ class ParallelWrapper:
             self._snapshotter.maybe_snapshot(self.net)
         snap = (g.snapshot(self.net)
                 if check and g.policy == "skip_step" else None)
+        t0 = time.perf_counter()
         thunk()
+        # every ParallelWrapper step/group funnels through here: the
+        # one emission site covers single-step, local-SGD, and
+        # multi-io paths alike (batched; fit() flushes at loop end)
+        self._obs_acc.count_observe(
+            "dl4j_train_steps_total", "dl4j_train_step_seconds",
+            time.perf_counter() - t0)
         if not check:
             return True
         verdict = g.post_step(self.net)
@@ -219,6 +231,7 @@ class ParallelWrapper:
         try:
             self._fit_loop(batches, epochs, k, wd)
         finally:
+            self._obs_acc.flush()
             if wd is not None:
                 wd.stop()
         return self

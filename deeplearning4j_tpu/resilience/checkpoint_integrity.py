@@ -34,6 +34,7 @@ import re
 import shutil
 from typing import Dict, Iterator, List, Optional, Tuple
 
+from deeplearning4j_tpu.observability import metrics as _obs
 from deeplearning4j_tpu.resilience.errors import CheckpointIntegrityError
 
 MANIFEST = "manifest.json"
@@ -127,8 +128,12 @@ def validate_file(directory: str, filename: str) -> bool:
         return True
     try:
         if os.path.getsize(path) != entry["size"]:
+            _obs.count("dl4j_checkpoint_validate_failures_total")
             return False
-        return sha256_file(path) == entry["sha256"]
+        if sha256_file(path) != entry["sha256"]:
+            _obs.count("dl4j_checkpoint_validate_failures_total")
+            return False
+        return True
     except OSError:
         return False
 
@@ -180,8 +185,10 @@ def validate_tree(directory: str) -> bool:
         path = os.path.join(directory, rel)
         try:
             if os.path.getsize(path) != ent["size"]:
+                _obs.count("dl4j_checkpoint_validate_failures_total")
                 return False
             if sha256_file(path) != ent["sha256"]:
+                _obs.count("dl4j_checkpoint_validate_failures_total")
                 return False
         except OSError:
             return False

@@ -63,6 +63,7 @@ import subprocess
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from deeplearning4j_tpu.observability import metrics as _obs
 from deeplearning4j_tpu.resilience import checkpoint_integrity as _ci
 from deeplearning4j_tpu.resilience.errors import (
     DeadlineExceededError,
@@ -436,6 +437,7 @@ class ClusterSupervisor:
     def _record_faults(self, faults: List[Tuple[int, str]],
                        resume_step: int) -> None:
         self.gang_restarts += 1
+        _obs.count("dl4j_cluster_gang_restarts_total")
         for rank, reason in faults:
             self.members[rank].restarts += 1
             self.restart_ledger.append({
@@ -453,8 +455,10 @@ class ClusterSupervisor:
         exhausted = [m.rank for m in self.members
                      if m.restarts > self.max_restarts_per_worker]
         if exhausted:
-            self.quarantined.extend(
-                r for r in exhausted if r not in self.quarantined)
+            new = [r for r in exhausted if r not in self.quarantined]
+            self.quarantined.extend(new)
+            _obs.count("dl4j_cluster_quarantined_workers_total",
+                       n=len(new))
             raise RestartsExhaustedError(
                 f"worker(s) {exhausted} exceeded "
                 f"max_restarts_per_worker={self.max_restarts_per_worker}"

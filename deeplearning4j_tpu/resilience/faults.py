@@ -45,6 +45,11 @@ Fault points wired through the stack:
                      degradation drill for the serving path)
   inference.complete ParallelInference completion stage, once per cycle
   serve.request      ModelServer request handler, once per POST
+  obs.emit           observability guarded-emission helpers, once per
+                     metric emission — `raise` simulates a broken
+                     telemetry backend; the emission helpers swallow it
+                     (counted as dropped), proving no step or request
+                     can ever fail because of telemetry
 
 `REGISTERED_POINTS` is the canonical registry: every `fire(...)` site
 in the package must use a name listed there, and the test suite pins
@@ -85,6 +90,7 @@ REGISTERED_POINTS = frozenset({
     "dist.heartbeat_stale",
     "inference.batch",
     "inference.complete",
+    "obs.emit",
     "serve.request",
     "train.grad_nonfinite",
     "train.hang",

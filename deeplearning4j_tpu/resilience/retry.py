@@ -15,6 +15,7 @@ import random
 import time
 from typing import Callable, Optional, Type
 
+from deeplearning4j_tpu.observability import metrics as _obs
 from deeplearning4j_tpu.resilience.errors import (
     CircuitOpenError,
     DeadlineExceededError,
@@ -87,6 +88,7 @@ class Retry:
                     raise DeadlineExceededError(
                         f"retry deadline {self.deadline_s}s exhausted "
                         f"after {attempt} attempts") from last
+            _obs.count("dl4j_retry_attempts_total")
             self._sleep(pause)
         raise RetriesExhaustedError(
             f"gave up after {self.max_attempts} attempts: {last!r}",
@@ -118,10 +120,16 @@ class CircuitBreaker:
         self._maybe_half_open()
         return self._state
 
+    def _transition(self, state: str) -> None:
+        if state != self._state:
+            self._state = state
+            _obs.count("dl4j_breaker_transitions_total",
+                       labels={"to": state})
+
     def _maybe_half_open(self):
         if (self._state == self.OPEN and self._opened_at is not None
                 and self._clock() - self._opened_at >= self.reset_timeout_s):
-            self._state = self.HALF_OPEN
+            self._transition(self.HALF_OPEN)
 
     def allow(self) -> bool:
         self._maybe_half_open()
@@ -130,13 +138,13 @@ class CircuitBreaker:
     def record_success(self):
         self._failures = 0
         self._opened_at = None
-        self._state = self.CLOSED
+        self._transition(self.CLOSED)
 
     def record_failure(self):
         self._failures += 1
         if (self._state == self.HALF_OPEN
                 or self._failures >= self.failure_threshold):
-            self._state = self.OPEN
+            self._transition(self.OPEN)
             self._opened_at = self._clock()
 
     def call(self, fn: Callable, *args, **kwargs):

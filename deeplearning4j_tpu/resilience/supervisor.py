@@ -45,6 +45,7 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.observability import metrics as _obs
 from deeplearning4j_tpu.resilience.errors import (
     NonFiniteLossError,
     RestartsExhaustedError,
@@ -180,17 +181,23 @@ class NonFiniteGuard:
         """Check the net after a step: 'ok' | 'nonfinite' | 'spike'.
         Accepted losses feed the spike EMA."""
         self.counters["checks"] += 1
+        _obs.count("dl4j_train_guard_checks_total")
         trees = (net.params,
                  net.updater_states if self.check_updater_state else ())
         ok_dev, loss_dev = self._check_fn()(net._score, trees)
         if not bool(ok_dev):
             self.counters["nonfinite"] += 1
+            _obs.count("dl4j_train_guard_nonfinite_total")
             return "nonfinite"
         loss = float(loss_dev)
+        # the loss is already on host here — the registry's train-loss
+        # gauge rides the guard's existing sync for free
+        _obs.set_gauge("dl4j_train_loss", loss)
         if (self.loss_spike_factor > 0.0 and self._ema is not None
                 and loss > self.loss_spike_factor
                 * max(abs(self._ema), 1e-8)):
             self.counters["spikes"] += 1
+            _obs.count("dl4j_train_guard_spikes_total")
             return "spike"
         self._ema = (loss if self._ema is None else
                      self.ema_decay * self._ema
@@ -200,9 +207,11 @@ class NonFiniteGuard:
     # --------------------------------------------------------- counters
     def note_skip(self) -> None:
         self.counters["skipped_steps"] += 1
+        _obs.count("dl4j_train_guard_skipped_steps_total")
 
     def note_rollback(self) -> None:
         self.counters["rollbacks"] += 1
+        _obs.count("dl4j_train_guard_rollbacks_total")
 
     def stats(self) -> dict:
         return {"policy": self.policy, "check_every": self.check_every,
@@ -276,6 +285,11 @@ class StepWatchdog:
         self.on_hang = on_hang
         self.heartbeat = heartbeat
         self.hang_exit_after = int(hang_exit_after)
+        # telemetry attach points (set by TrainingMaster when a tracer
+        # is wired): hang events recorded on the monitor THREAD get
+        # explicitly parented to the training thread's current step span
+        self.tracer = None
+        self.trace_parent = None
         self.counters = {"beats": 0, "hangs_detected": 0}
         self._last: Optional[float] = None
         self._phase = "idle"
@@ -350,6 +364,16 @@ class StepWatchdog:
             if age < self.timeout_s:
                 continue
             self.counters["hangs_detected"] += 1
+            _obs.count("dl4j_train_watchdog_hangs_total")
+            if self.tracer is not None:
+                try:
+                    self.tracer.instant(
+                        "watchdog_hang", cat="resilience",
+                        parent=self.trace_parent,
+                        args={"phase": self._phase,
+                              "age_s": round(age, 3)})
+                except Exception:   # noqa: BLE001 - telemetry best-effort
+                    pass
             self._last = time.monotonic()   # re-arm, don't spam
             # consecutive = no fresh beat since the previous detection:
             # the soft (signal) escalation did not land
@@ -513,6 +537,7 @@ class Supervisor:
                     self.max_backoff_s)
                 entry["backoff_s"] = round(backoff, 3)
                 self.restart_ledger.append(entry)
+                _obs.count("dl4j_train_supervisor_restarts_total")
                 logger.warning(
                     "Supervisor: restart %d/%d after %s: %s (backoff "
                     "%.2fs)", attempt + 1, self.max_restarts,

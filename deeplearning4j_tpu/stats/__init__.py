@@ -12,4 +12,5 @@ from deeplearning4j_tpu.stats.dashboard import (  # noqa: F401
     collect_network_flow,
     embedding_scatter,
     render_html,
+    telemetry_lines,
 )

@@ -34,7 +34,7 @@ _PAGE = """<!DOCTYPE html>
 <h1>Training session <code>{session}</code></h1>
 <p class="meta">{n} reports · final score {final_score} ·
  {sps} samples/sec · ETL {etl} ms · device mem {dev_mem} MB</p>
-<div id="resil"></div>
+<div id="telemetry"></div>
 <div id="charts" class="row"></div>
 <h2>Parameter mean magnitudes (log10)</h2>
 <div id="pmm" class="row"></div>
@@ -50,30 +50,13 @@ _PAGE = """<!DOCTYPE html>
 <div id="tsne" class="row"></div>
 <script>
 const DATA = {data};
-if (DATA.resilience) {{
-  // self-healing counters (guard skips/rollbacks, watchdog hangs,
-  // preemptions, supervisor restarts) from training_stats()
-  const R = DATA.resilience, parts = [];
-  if (R.guard) parts.push(`guard[${{R.guard.policy}}]: ` +
-    `${{R.guard.checks}} checks, ${{R.guard.nonfinite}} non-finite, ` +
-    `${{R.guard.spikes}} spikes, ${{R.guard.skipped_steps}} skipped, ` +
-    `${{R.guard.rollbacks}} rollbacks`);
-  if (R.watchdog) parts.push(
-    `watchdog: ${{R.watchdog.hangs_detected}} hangs detected`);
-  if (R.preemption) parts.push(
-    `preemptions: ${{R.preemption.preemptions}}`);
-  if (R.supervisor) parts.push(
-    `supervisor restarts: ${{R.supervisor.restarts}}` +
-    `/${{R.supervisor.max_restarts}}`);
-  if (R.counters) parts.push(
-    `data-skipped steps: ${{R.counters.data_skipped_steps}}`);
-  if (R.cluster) parts.push(
-    `cluster: ${{R.cluster.gang_restarts}} gang restarts over ` +
-    `${{R.cluster.generations}} generations` +
-    (R.cluster.quarantined.length
-      ? `, quarantined workers [${{R.cluster.quarantined}}]` : ''));
-  document.getElementById('resil').innerHTML =
-    '<p class="meta">self-healing — ' + parts.join(' · ') + '</p>';
+if (DATA.telemetry_lines && DATA.telemetry_lines.length) {{
+  // one substrate: the self-healing / cluster / serving lines are
+  // derived (in Python, telemetry_lines) from a MetricsRegistry
+  // snapshot instead of per-component stats dicts; the raw snapshot
+  // rides along as DATA.telemetry for programmatic consumers
+  document.getElementById('telemetry').innerHTML = DATA.telemetry_lines
+    .map(l => '<p class="meta">' + l + '</p>').join('');
 }}
 function svgLine(pts, w, h, color) {{
   if (pts.length === 0) return '';
@@ -335,23 +318,94 @@ def embedding_scatter(vectors, labels=None, perplexity: float = 20.0,
             "kl": round(t.kl_, 4) if t.kl_ is not None else None}
 
 
+def telemetry_lines(snapshot) -> list:
+    """Human-readable status lines derived from a
+    `MetricsRegistry.snapshot()` (or a registry itself) — the
+    single-substrate replacement for the per-component stats dicts the
+    dashboard used to reach into. Returns [] when the snapshot carries
+    none of the relevant metrics; the self-healing, cluster, and
+    serving lines are pinned by test."""
+    if snapshot is None:
+        return []
+    if hasattr(snapshot, "snapshot"):   # a MetricsRegistry
+        snapshot = snapshot.snapshot()
+    c = {name: int(sum(series.values()))
+         for name, series in snapshot.get("counters", {}).items()}
+    hists = snapshot.get("histograms", {})
+
+    def gauge(name):
+        series = snapshot.get("gauges", {}).get(name)
+        if not series:
+            return None
+        return list(series.values())[-1]
+
+    lines = []
+    heal = []
+    if any(k.startswith("dl4j_train_guard_") for k in c):
+        heal.append(
+            f"guard: {c.get('dl4j_train_guard_checks_total', 0)} "
+            f"checks, {c.get('dl4j_train_guard_nonfinite_total', 0)} "
+            f"non-finite, {c.get('dl4j_train_guard_spikes_total', 0)} "
+            f"spikes, "
+            f"{c.get('dl4j_train_guard_skipped_steps_total', 0)} "
+            f"skipped, "
+            f"{c.get('dl4j_train_guard_rollbacks_total', 0)} rollbacks")
+    if "dl4j_train_watchdog_hangs_total" in c:
+        heal.append(f"watchdog: {c['dl4j_train_watchdog_hangs_total']} "
+                    "hangs detected")
+    if "dl4j_train_preemptions_total" in c:
+        heal.append(
+            f"preemptions: {c['dl4j_train_preemptions_total']}")
+    if "dl4j_train_supervisor_restarts_total" in c:
+        heal.append(f"supervisor restarts: "
+                    f"{c['dl4j_train_supervisor_restarts_total']}")
+    if "dl4j_train_data_skipped_steps_total" in c:
+        heal.append(f"data-skipped steps: "
+                    f"{c['dl4j_train_data_skipped_steps_total']}")
+    if heal:
+        lines.append("self-healing — " + " · ".join(heal))
+    if ("dl4j_cluster_gang_restarts_total" in c
+            or "dl4j_cluster_quarantined_workers_total" in c):
+        lines.append(
+            "cluster — "
+            f"{c.get('dl4j_cluster_gang_restarts_total', 0)} gang "
+            "restarts · "
+            f"{c.get('dl4j_cluster_quarantined_workers_total', 0)} "
+            "quarantined workers")
+    if "dl4j_serving_requests_total" in c:
+        serv = [f"{c['dl4j_serving_requests_total']} requests "
+                f"({c.get('dl4j_serving_errors_total', 0)} errors)"]
+        qd = gauge("dl4j_serving_queue_depth")
+        if qd is not None:
+            serv.append(f"queue depth {int(qd)}")
+        if "dl4j_serving_batches_total" in c:
+            serv.append(f"{c['dl4j_serving_batches_total']} batches")
+        occ = hists.get("dl4j_serving_batch_occupancy")
+        if occ and occ.get("p50") is not None:
+            serv.append(f"occupancy p50 {occ['p50']:g}")
+        lines.append("serving — " + " · ".join(serv))
+    return lines
+
+
 def render_html(storage: StatsStorage, session_id: Optional[str] = None,
                 path: Optional[str] = None, activations=None,
-                embedding=None, flow=None, resilience=None) -> str:
+                embedding=None, flow=None, telemetry=None) -> str:
     """Render a self-contained HTML report; write to `path` if given.
     Defaults to the storage's only (or first) session. `activations`
     (collect_conv_activations), `embedding` (embedding_scatter) and
     `flow` (collect_network_flow) fill the conv-activation, t-SNE and
-    network-graph tabs; `resilience`
-    (TrainingMaster.resilience_stats()) renders the self-healing
-    counter line (guard skips/rollbacks, watchdog hangs, preemptions,
-    supervisor restarts; add a `cluster` key — ClusterSupervisor
-    .stats() — for gang-restart/quarantine counters)."""
+    network-graph tabs; `telemetry` (a MetricsRegistry — typically
+    `observability.get_registry()` — or its `.snapshot()`) renders the
+    self-healing / cluster / serving status lines from the ONE metrics
+    substrate instead of per-component stats dicts, and embeds the raw
+    snapshot as DATA.telemetry."""
     sessions = storage.session_ids()
     if not sessions:
         raise ValueError("storage has no sessions")
     if session_id is None:
         session_id = sessions[0]
+    if telemetry is not None and hasattr(telemetry, "snapshot"):
+        telemetry = telemetry.snapshot()
     reports = storage.reports(session_id)
     latest = reports[-1] if reports else None
     fmt = lambda v, nd=1: "–" if v is None else f"{v:.{nd}f}"
@@ -368,7 +422,8 @@ def render_html(storage: StatsStorage, session_id: Optional[str] = None,
                          "activations": activations,
                          "embedding": embedding,
                          "flow": flow,
-                         "resilience": resilience}),
+                         "telemetry": telemetry,
+                         "telemetry_lines": telemetry_lines(telemetry)}),
     )
     if path:
         with open(path, "w") as f:
@@ -428,7 +483,15 @@ class UIServer:
                     sid = None
                     if self.path.startswith("/session/"):
                         sid = self.path.split("/session/", 1)[1] or None
-                    body = render_html(server._storage, sid).encode()
+                    # live dashboard auto-attaches the process-global
+                    # registry: self-healing / cluster / serving lines
+                    # render from whatever this process has emitted
+                    from deeplearning4j_tpu.observability import (
+                        get_registry,
+                    )
+
+                    body = render_html(server._storage, sid,
+                                       telemetry=get_registry()).encode()
                     self.send_response(200)
                     self.send_header("Content-Type",
                                      "text/html; charset=utf-8")

@@ -86,6 +86,11 @@ def write_model(net, path, save_updater: bool = True,
     pre-publish bytes so torn writes are detected on restore."""
     if net.params is None:
         raise ValueError("Network not initialized; nothing to save")
+    import time as _time
+
+    from deeplearning4j_tpu.observability import metrics as _obs
+
+    t_write = _time.perf_counter()
     path = os.fspath(path)
     with atomic_writer(path) as tmp:
         with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as z:
@@ -111,6 +116,9 @@ def write_model(net, path, save_updater: bool = True,
         with open(_checksum_path(path) + ".tmp", "w") as f:
             f.write(digest)
         os.replace(_checksum_path(path) + ".tmp", _checksum_path(path))
+    _obs.count("dl4j_checkpoint_writes_total")
+    _obs.observe("dl4j_checkpoint_write_seconds",
+                 _time.perf_counter() - t_write)
 
 
 def verify_model(path) -> bool:

@@ -26,9 +26,35 @@ jax.config.update("jax_platform_name", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
-# persistent compile cache: repeat test runs skip XLA compilation
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+# Persistent compile cache: repeat test runs skip XLA compilation.
+# Hardening (learned the hard way): a run killed mid-cache-write leaves a
+# torn entry that SEGFAULTS XLA deserialization on every later run — the
+# exact torn-write failure mode checkpoint_integrity guards against, so
+# the cache gets the same treatment: the dir is scoped to the jaxlib
+# version (env drift can't mix incompatible entries), and a clean-exit
+# sentinel is removed at session start / rewritten at session finish, so
+# a cache left behind by an interrupted run is wiped, not trusted.
+import pathlib  # noqa: E402
+import shutil  # noqa: E402
+
+import jaxlib  # noqa: E402
+
+_JAX_CACHE = pathlib.Path(f"/tmp/jax_test_cache-{jaxlib.__version__}")
+_CACHE_SENTINEL = _JAX_CACHE / ".clean-exit"
+if _JAX_CACHE.exists() and not _CACHE_SENTINEL.exists():
+    shutil.rmtree(_JAX_CACHE, ignore_errors=True)
+_JAX_CACHE.mkdir(parents=True, exist_ok=True)
+_CACHE_SENTINEL.unlink(missing_ok=True)
+jax.config.update("jax_compilation_cache_dir", str(_JAX_CACHE))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    # only a session that ENDED marks its cache trustworthy
+    try:
+        _CACHE_SENTINEL.touch()
+    except OSError:
+        pass
 
 
 @pytest.fixture

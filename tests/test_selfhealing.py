@@ -608,6 +608,9 @@ def test_fault_point_registry_matches_source_and_tests():
     # fails the set equality above; this names them explicitly)
     assert {"dist.heartbeat_stale", "train.hang_hard"} \
         <= set(REGISTERED_POINTS)
+    # PR 5 pin: telemetry emission rides its own fault domain —
+    # "obs.emit" failures must be swallowed (tests/test_observability)
+    assert "obs.emit" in REGISTERED_POINTS
 
 
 # ================================================= orbax manifest parity
@@ -759,10 +762,16 @@ def test_training_stats_surface_resilience_counters(tmp_path):
 
 
 def test_dashboard_renders_resilience_line(tmp_path):
+    """PR 5 rewrite: the dashboard's self-healing line renders from a
+    MetricsRegistry snapshot (the one telemetry substrate) instead of
+    reaching into per-component stats dicts — the TrainingMaster fit
+    below feeds the registry natively."""
+    from deeplearning4j_tpu.observability import get_registry
     from deeplearning4j_tpu.stats.dashboard import render_html
     from deeplearning4j_tpu.stats.listener import StatsListener
     from deeplearning4j_tpu.stats.storage import InMemoryStatsStorage
 
+    get_registry().reset()
     net = _net()
     storage = InMemoryStatsStorage()
     net.listeners.append(StatsListener(storage, frequency=1,
@@ -770,13 +779,13 @@ def test_dashboard_renders_resilience_line(tmp_path):
     g = NonFiniteGuard(policy="skip_step", check_every=1)
     tm = TrainingMaster(net, guard=g)
     tm.fit(lambda s: _batch(s), 2)
-    # cluster counters ride the same resilience block (satellite:
-    # gang-restart/quarantine visibility in the dashboard)
-    from deeplearning4j_tpu.resilience import ClusterSupervisor
-
-    cs = ClusterSupervisor(2, lambda *a: ["true"],
-                           str(tmp_path / "hb"))
-    resil = dict(tm.resilience_stats(), cluster=cs.stats())
-    page = render_html(storage, resilience=resil)
-    assert "DATA.resilience" in page and '"policy": "skip_step"' in page
-    assert '"gang_restarts": 0' in page and "R.cluster" in page
+    page = render_html(storage, telemetry=get_registry())
+    assert "DATA.telemetry" in page
+    # (json.dumps escapes the em-dash, so pin around it)
+    assert "self-healing" in page and "guard: 2 checks" in page
+    assert "dl4j_train_guard_checks_total" in page   # raw snapshot rides
+    # cluster counters ride the same substrate (gang-restart /
+    # quarantine visibility preserved, satellite pin)
+    get_registry().inc("dl4j_cluster_gang_restarts_total", 2)
+    page2 = render_html(storage, telemetry=get_registry())
+    assert "2 gang restarts" in page2
