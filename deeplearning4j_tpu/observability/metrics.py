@@ -80,6 +80,15 @@ REGISTERED_METRICS = frozenset({
     "dl4j_serving_batches_total",
     "dl4j_serving_batch_occupancy",
     "dl4j_serving_bucket_splits_total",
+    # serving control plane (multi-model registry, tenants, routing)
+    "dl4j_serving_model_requests_total",
+    "dl4j_serving_admitted_total",
+    "dl4j_serving_shed_total",
+    "dl4j_serving_swaps_total",
+    "dl4j_serving_rollbacks_total",
+    "dl4j_serving_load_rejected_total",
+    "dl4j_serving_active_models",
+    "dl4j_serving_replica_failovers_total",
     "dl4j_jit_traces_total",
     # resilience plumbing
     "dl4j_retry_attempts_total",

@@ -20,6 +20,14 @@ a coincidence. A `jax.profiler` device trace captured in the same run
 (ProfilerListener) is registered on this timeline as a span carrying
 its trace_dir, so host spans and the device profile can be correlated.
 
+Continuous export: `start_background_flush(path, interval_s)` runs a
+daemon thread that periodically DRAINS the ring buffer to a JSONL file
+(one span dict per line) — long-running jobs stop losing spans to ring
+wrap-around, and the export no longer depends on someone remembering
+to call it. `stop_background_flush()` flushes the remainder;
+`load_flushed(path)` reads the file back. The in-memory ring keeps
+feeding `export_chrome_trace()` for ad-hoc snapshots between flushes.
+
 Tracing is opt-in per component (`tracer=None` default everywhere):
 the hot paths pay nothing unless a tracer is attached.
 """
@@ -77,15 +85,47 @@ class Span:
 class Tracer:
     """Bounded-buffer span recorder (thread-safe)."""
 
-    def __init__(self, max_spans: int = 20000):
+    def __init__(self, max_spans: int = 20000,
+                 flush_path: Optional[str] = None,
+                 flush_interval_s: float = 2.0):
+        """`flush_path` (optional) starts the continuous background
+        flush at construction: every `flush_interval_s` the ring is
+        drained to that JSONL file (and once more on stop)."""
         self._lock = threading.Lock()
         self._buf: deque = deque(maxlen=max(1, int(max_spans)))
         self.max_spans = int(max_spans)
         self._ids = itertools.count(1)
         self._recorded = 0
+        self._flushed = 0
         self._t0 = time.perf_counter()
         self._wall0 = time.time()
         self._local = threading.local()
+        self._flush_path: Optional[str] = None
+        self._flush_interval_s = float(flush_interval_s)
+        self._flush_stop = threading.Event()
+        self._flush_wake = threading.Event()
+        self._flush_thread: Optional[threading.Thread] = None
+        self._flush_file_lock = threading.Lock()
+        if flush_path is not None:
+            self.start_background_flush(flush_path, flush_interval_s)
+
+    def _append(self, sp: "Span") -> None:
+        """Buffer a finished span. Under continuous flush the ring
+        never drops: a half-full ring wakes the flusher early, and a
+        FULL ring makes the producer drain it inline (one amortized
+        write per max_spans/2 spans, only when the flusher is starved)
+        — the perfetto-style stall-don't-lose discipline."""
+        with self._lock:
+            full = (self._flush_path is not None
+                    and len(self._buf) >= self.max_spans - 1)
+            self._buf.append(sp)
+            self._recorded += 1
+            pressure = (self._flush_path is not None
+                        and 2 * len(self._buf) >= self.max_spans)
+        if full:
+            self.flush_now()
+        elif pressure:
+            self._flush_wake.set()
 
     # ------------------------------------------------------------ clock
     def _now_us(self) -> float:
@@ -128,9 +168,7 @@ class Tracer:
     def _finish(self, span: Span) -> None:
         if span.dur_us is None:
             span.dur_us = max(0.0, self._now_us() - span.t0_us)
-        with self._lock:
-            self._buf.append(span)
-            self._recorded += 1
+        self._append(span)
 
     @contextmanager
     def span(self, name: str, cat: str = "host", parent=None,
@@ -155,9 +193,7 @@ class Tracer:
                   self._parent_id(parent), self._to_us(start_perf), args)
         sp.dur_us = max(0.0, (end_perf - start_perf) * 1e6)
         sp._done = True
-        with self._lock:
-            self._buf.append(sp)
-            self._recorded += 1
+        self._append(sp)
         return sp
 
     def instant(self, name: str, cat: str = "host", parent=None,
@@ -165,9 +201,7 @@ class Tracer:
         sp = self.begin(name, cat=cat, parent=parent, args=args)
         sp.dur_us = 0.0
         sp._done = True
-        with self._lock:
-            self._buf.append(sp)
-            self._recorded += 1
+        self._append(sp)
         return sp
 
     # ------------------------------------------------------------ reads
@@ -179,9 +213,98 @@ class Tracer:
         with self._lock:
             buffered = len(self._buf)
             recorded = self._recorded
+            flushed = self._flushed
         return {"recorded": recorded, "buffered": buffered,
-                "dropped": recorded - buffered,
-                "max_spans": self.max_spans}
+                "flushed": flushed,
+                "dropped": recorded - buffered - flushed,
+                "max_spans": self.max_spans,
+                "flush_path": self._flush_path,
+                "flush_running": (
+                    self._flush_thread is not None
+                    and self._flush_thread.is_alive())}
+
+    # ------------------------------------------------- continuous flush
+    def start_background_flush(self, path: str,
+                               interval_s: Optional[float] = None
+                               ) -> None:
+        """Start (or retarget) the continuous flush: a daemon thread
+        drains the ring to `path` as JSONL every `interval_s` seconds,
+        so spans survive ring wrap-around without manual exports.
+        Idempotent per path; `stop_background_flush()` flushes the
+        remainder and joins the thread."""
+        if interval_s is not None:
+            self._flush_interval_s = float(interval_s)
+        self._flush_path = path
+        if self._flush_thread is not None \
+                and self._flush_thread.is_alive():
+            return
+        self._flush_stop.clear()
+        self._flush_thread = threading.Thread(
+            target=self._flush_loop, daemon=True,
+            name="Tracer-span-flush")
+        self._flush_thread.start()
+
+    def stop_background_flush(self) -> int:
+        """Stop the flush thread and flush whatever is still buffered
+        (the flush-on-stop half of the contract). Returns the number
+        of spans written by the final flush. Safe to call twice."""
+        self._flush_stop.set()
+        self._flush_wake.set()   # unblock the interval wait
+        t, self._flush_thread = self._flush_thread, None
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
+        self._flush_stop.clear()   # a later start() can restart
+        return self.flush_now()
+
+    def flush_now(self) -> int:
+        """Drain every completed span in the ring to the flush file
+        (JSONL, one span dict per line). Returns spans written; no-op
+        without a flush path."""
+        if self._flush_path is None:
+            return 0
+        with self._lock:
+            spans = [s.to_dict() for s in self._buf]
+            self._buf.clear()
+            self._flushed += len(spans)
+        if not spans:
+            return 0
+        try:
+            with self._flush_file_lock:
+                with open(self._flush_path, "a") as f:
+                    for s in spans:
+                        f.write(json.dumps(s) + "\n")
+        except OSError:
+            # a full disk must not take down the job — the spans are
+            # simply lost (still counted as flushed, not buffered)
+            pass
+        return len(spans)
+
+    def _flush_loop(self) -> None:
+        while True:
+            self._flush_wake.wait(self._flush_interval_s)
+            self._flush_wake.clear()
+            if self._flush_stop.is_set():
+                return   # stop_background_flush does the final drain
+            self.flush_now()
+
+    @staticmethod
+    def load_flushed(path: str) -> List[dict]:
+        """Read a flush file back into span dicts (skips torn tail
+        lines from a crash mid-write)."""
+        out: List[dict] = []
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        continue
+        except OSError:
+            pass
+        return out
 
     # ----------------------------------------------------------- export
     def export_chrome_trace(self, path: Optional[str] = None) -> dict:

@@ -19,11 +19,21 @@ COMPLETION stage without blocking on the host fetch — JAX dispatch is
 async, so batch N+1 assembles and dispatches while batch N computes.
 The completion stage performs the host fetch (the 4-6 ms per-dispatch
 RTT measured in PERF.md), slices rows back to their callers, and
-returns the staging buffer to the pool. The in-flight window is bounded
+returns the staging buffer to the pool. `completion_streams` (default
+2) completion threads pay fetch RTTs CONCURRENTLY — with one stream a
+slow fetch serializes the window even though the device is free.
+Completions may land out of dispatch order; per-row-range delivery
+makes that harmless. The in-flight window is bounded
 (`pipeline_depth`), so backpressure still cascades: window full ->
 assembler stalls -> request queue fills -> `output()` sheds load.
 `pipeline_depth=0` degrades to the serialized dispatch-then-fetch loop
 (the bench_serving.py comparison baseline).
+
+Multi-input coalescing: a request may carry one array per network
+input (`output(x_a, x_b)` — ComputationGraph-style named inputs), all
+sharing the batch dim. Each input stream coalesces into its own pooled
+bucket buffer and the batch dispatches as `net.output(*bufs)`;
+multi-output models deliver a list of arrays per caller.
 
 Compile-once guards: `warmup=True` pre-traces `net.output` for every
 power-of-two bucket up to the cap at construction (shape derived from
@@ -78,21 +88,28 @@ class InferenceMode:
 
 
 class _Pending:
-    """One caller's request. Large requests may be split across several
-    dispatched batches (bucket-cap overshoot guard); `deliver` collects
-    row ranges and resolves once every row has arrived. deliver() is
-    only ever called from the single completion stage, so it needs no
-    lock of its own."""
+    """One caller's request — one or more equal-row input arrays (a
+    multi-input ComputationGraph request is a tuple of named-input
+    streams sharing one batch dim). Large requests may be split across
+    several dispatched batches (bucket-cap overshoot guard); `deliver`
+    collects row ranges per output stream and resolves once every row
+    has arrived. Deliveries for one request never race (each row range
+    lives in exactly one batch and batches touch disjoint ranges), so
+    no lock of its own is needed."""
 
-    __slots__ = ("x", "event", "result", "_left", "_out", "span")
+    __slots__ = ("xs", "event", "result", "_left", "_out", "span")
 
-    def __init__(self, x):
-        self.x = x
+    def __init__(self, xs):
+        self.xs = xs               # tuple of per-input arrays
         self.event = threading.Event()
         self.result = None
-        self._left = x.shape[0]
-        self._out = None
+        self._left = xs[0].shape[0]
+        self._out = None           # list of per-output buffers (splits)
         self.span = None   # open request span (tracer attached only)
+
+    @property
+    def rows(self) -> int:
+        return self.xs[0].shape[0]
 
     def resolve(self, result):
         if not self.event.is_set():
@@ -106,20 +123,28 @@ class _Pending:
                 except Exception:   # noqa: BLE001 - telemetry best-effort
                     pass
 
-    def deliver(self, start: int, rows: np.ndarray) -> bool:
-        """Returns True when this delivery completed the request."""
+    def deliver(self, start: int, rows_list: List[np.ndarray],
+                multi: bool) -> bool:
+        """Hand this request `rows_list` (one array per model OUTPUT)
+        covering its rows [start, start+n). Returns True when the
+        delivery completed the request. `multi` keeps the resolved
+        shape honest: single-output models resolve to a bare array."""
         if self.event.is_set():
             return False
-        n = self.x.shape[0]
-        if self._out is None and start == 0 and rows.shape[0] == n:
-            self.resolve(rows)   # whole request in one batch (common)
+        n = self.xs[0].shape[0]
+        got = rows_list[0].shape[0]
+        if self._out is None and start == 0 and got == n:
+            # whole request in one batch (the common case)
+            self.resolve(list(rows_list) if multi else rows_list[0])
             return True
         if self._out is None:
-            self._out = np.empty((n,) + rows.shape[1:], rows.dtype)
-        self._out[start:start + rows.shape[0]] = rows
-        self._left -= rows.shape[0]
+            self._out = [np.empty((n,) + r.shape[1:], r.dtype)
+                         for r in rows_list]
+        for out, r in zip(self._out, rows_list):
+            out[start:start + got] = r
+        self._left -= got
         if self._left <= 0:
-            self.resolve(self._out)
+            self.resolve(self._out if multi else self._out[0])
             return True
         return False
 
@@ -145,13 +170,21 @@ class ParallelInference:
                  adaptive_wait: bool = True,
                  min_wait_ms: float = 0.0,
                  warmup_inputs=None,
+                 completion_streams: int = 2,
                  tracer=None):
         """`warmup_inputs`: per-example input shapes for nets whose
-        shape is underivable from the conf (multi-input
-        ComputationGraphs, stub nets) — a sequence with one entry per
-        network input, each either a shape tuple (no batch dim) or an
-        example array whose leading dim is the batch. Without it such
-        nets skip warmup (warned once per process).
+        shape is underivable from the conf (stub nets, graphs without
+        input types) — a sequence with one entry per network input,
+        each either a shape tuple (no batch dim) or an example array
+        whose leading dim is the batch. Multi-input ComputationGraphs
+        with configured input types derive their shapes automatically;
+        without either, warmup is skipped (warned once per process).
+
+        `completion_streams`: how many completion-stage threads pay
+        host-fetch RTTs concurrently (default 2 — one fetch at a time
+        was the recorded PR 2 gap). Only meaningful with
+        pipeline_depth > 0; completions may finish out of dispatch
+        order, which per-row delivery makes harmless.
 
         `tracer` (observability.Tracer, optional): records per-request
         spans (enqueue→…→deliver) and per-batch spans on BOTH pipeline
@@ -167,14 +200,17 @@ class ParallelInference:
         self.adaptive_wait = adaptive_wait
         self.default_timeout_s = default_timeout_s
         self.pipeline_depth = max(0, int(pipeline_depth))
+        self.completion_streams = max(1, int(completion_streams))
         self._cap = self._bucket(batch_limit)   # hard bucket-shape ceiling
         self._queue: "queue.Queue[_Pending]" = queue.Queue(maxsize=queue_limit)
         self._lock = threading.Lock()
+        self._count_lock = threading.Lock()   # _inflight_n (k completers)
         self._stop = threading.Event()
         self._shutdown = False
         self._failure: Optional[BaseException] = None
         self._worker: Optional[threading.Thread] = None
         self._completer: Optional[threading.Thread] = None
+        self._completers: List[threading.Thread] = []
         self._inflight: Optional["queue.Queue"] = None
         # dispatched-but-not-completed batches, INCLUDING the one the
         # completion stage is currently fetching (queue size alone
@@ -195,10 +231,13 @@ class ParallelInference:
                 self.warmup()
             if self.pipeline_depth > 0:
                 self._inflight = queue.Queue()
-                self._completer = threading.Thread(
-                    target=self._completion_loop, daemon=True,
-                    name="ParallelInference-completer")
-                self._completer.start()
+                for i in range(self.completion_streams):
+                    t = threading.Thread(
+                        target=self._completion_loop, daemon=True,
+                        name=f"ParallelInference-completer-{i}")
+                    t.start()
+                    self._completers.append(t)
+                self._completer = self._completers[0]
             self._worker = threading.Thread(
                 target=self._batch_loop, daemon=True,
                 name="ParallelInference-batcher")
@@ -213,13 +252,16 @@ class ParallelInference:
         if self.mode == InferenceMode.BATCHED:
             if self._worker is None or not self._worker.is_alive():
                 return False
-            if (self._completer is not None
-                    and not self._completer.is_alive()):
+            if any(not t.is_alive() for t in self._completers):
                 return False
         return True
 
     def queue_depth(self) -> int:
         return self._queue.qsize()
+
+    @property
+    def queue_limit(self) -> int:
+        return self._queue.maxsize
 
     def trace_stats(self) -> dict:
         """The net's JitCache trace counters (empty for nets without
@@ -234,6 +276,8 @@ class ParallelInference:
         """Pipeline + compile-guard facts (surfaced on /status)."""
         out = {
             "pipeline_depth": self.pipeline_depth,
+            "completion_streams": (self.completion_streams
+                                   if self.pipeline_depth > 0 else 0),
             "in_flight": self._inflight_n,
             "queue_depth": self._queue.qsize(),
             "batches_dispatched": self._batches_dispatched,
@@ -261,8 +305,9 @@ class ParallelInference:
 
     def _warmup_shapes(self) -> Optional[List[tuple]]:
         """Per-example shape for every network input: explicit
-        `warmup_inputs` first, else derived from the conf's InputType;
-        None when underivable either way."""
+        `warmup_inputs` first, then multi-input ComputationGraph input
+        types, then the single-input conf InputType; None when
+        underivable every way."""
         if self.warmup_inputs is not None:
             shapes = []
             for w in self.warmup_inputs:
@@ -272,6 +317,15 @@ class ParallelInference:
                 else:
                     shapes.append(tuple(np.asarray(w).shape[1:]))
             return shapes
+        conf = getattr(self.net, "conf", None)
+        names = getattr(conf, "network_inputs", None)
+        itypes = getattr(conf, "input_types", None)
+        if names and itypes and set(itypes) >= set(names):
+            try:
+                return [tuple(itypes[n].batch_shape(1))[1:]
+                        for n in names]
+            except Exception:   # noqa: BLE001 - underivable shape: skip
+                pass
         tail = self._warmup_tail_shape()
         return None if tail is None else [tail]
 
@@ -324,25 +378,40 @@ class ParallelInference:
         return (self._completer is not None
                 and not self._completer.is_alive())
 
-    def output(self, x, timeout_s: Optional[float] = None) -> np.ndarray:
+    def output(self, *xs, timeout_s: Optional[float] = None):
         """Run inference; raises OverloadedError when the bounded queue
         is full (shed load, don't queue unbounded latency) and
         DeadlineExceededError / InferenceUnavailableError instead of
-        hanging when the pipeline stalls or dies."""
-        x = np.asarray(x)
+        hanging when the pipeline stalls or dies.
+
+        Multi-input graphs pass one array per network input
+        (`pi.output(x_a, x_b)`), all sharing the batch dim — the
+        streams coalesce through the same pooled-bucket path, one
+        bucket buffer per input. Multi-output models resolve to a list
+        of arrays (single-output stays a bare array)."""
+        xs = tuple(np.asarray(x) for x in xs)
+        if not xs:
+            raise ValueError("output() needs at least one input array")
+        if any(x.shape[0] != xs[0].shape[0] for x in xs[1:]):
+            raise ValueError(
+                "all inputs must share the batch dim: "
+                f"{[x.shape[0] for x in xs]}")
         if timeout_s is None:
             timeout_s = self.default_timeout_s
         if self.mode == InferenceMode.SEQUENTIAL:
             self._check_available()
             with self._lock:
-                return np.asarray(self.net.output(x))
+                out = self.net.output(*xs)
+                return ([np.asarray(o) for o in out]
+                        if isinstance(out, (list, tuple))
+                        else np.asarray(out))
         self._check_available()
-        p = _Pending(x)
+        p = _Pending(xs)
         if self.tracer is not None:
             try:
                 p.span = self.tracer.begin(
                     "request", cat="serving",
-                    args={"rows": int(x.shape[0])})
+                    args={"rows": int(xs[0].shape[0])})
             except Exception:   # noqa: BLE001 - telemetry best-effort
                 p.span = None
         try:
@@ -387,8 +456,8 @@ class ParallelInference:
         self._stop.set()
         if self._worker is not None:
             self._worker.join(timeout=2.0)
-        if self._completer is not None:
-            self._completer.join(timeout=2.0)
+        for t in self._completers:
+            t.join(timeout=2.0)
         err = ShutdownError(
             "ParallelInference shut down with requests in flight")
         self._drain(err)
@@ -414,13 +483,14 @@ class ParallelInference:
             return
         while True:
             try:
-                _, slots, key, buf, _ = self._inflight.get_nowait()
+                _, slots, keys, bufs, _ = self._inflight.get_nowait()
             except queue.Empty:
                 return
-            self._inflight_n -= 1
+            with self._count_lock:
+                self._inflight_n -= 1
             for p, _, _ in slots:
                 p.resolve(error)
-            self._put_buffer(key, buf)
+            self._put_buffers(keys, bufs)
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -443,6 +513,10 @@ class ParallelInference:
         pool = self._buf_pool.setdefault(key, [])
         if len(pool) <= self.pipeline_depth:
             pool.append(buf)
+
+    def _put_buffers(self, keys: List[tuple], bufs: List[np.ndarray]):
+        for key, buf in zip(keys, bufs):
+            self._put_buffer(key, buf)
 
     # --------------------------------------------------- adaptive wait
     def _current_wait_s(self) -> float:
@@ -477,10 +551,10 @@ class ParallelInference:
         if self._carry is not None:
             p, src = self._carry
             self._carry = None
-            take = min(p.x.shape[0] - src, limit)
+            take = min(p.rows - src, limit)
             slots.append((p, src, take))
             rows += take
-            if src + take < p.x.shape[0]:
+            if src + take < p.rows:
                 self._carry = (p, src + take)
                 return slots, rows
         else:
@@ -488,10 +562,10 @@ class ParallelInference:
                 first = self._queue.get(timeout=0.05)
             except queue.Empty:
                 return slots, 0
-            take = min(first.x.shape[0], limit)
+            take = min(first.rows, limit)
             slots.append((first, 0, take))
             rows += take
-            if take < first.x.shape[0]:
+            if take < first.rows:
                 self._carry = (first, take)
                 _obs.count("dl4j_serving_bucket_splits_total")
                 return slots, rows
@@ -521,32 +595,46 @@ class ParallelInference:
                     p = self._queue.get(timeout=max(0.0, remaining))
                 except queue.Empty:
                     break
-            take = min(p.x.shape[0], limit - rows)
+            take = min(p.rows, limit - rows)
             slots.append((p, 0, take))
             rows += take
-            if take < p.x.shape[0]:
+            if take < p.rows:
                 self._carry = (p, take)
                 _obs.count("dl4j_serving_bucket_splits_total")
                 break
         return slots, rows
 
     def _assemble(self, slots: List[_Slot], rows: int):
-        """Coalesce request rows directly into a pooled padded bucket
-        buffer — ONE copy, no intermediate concatenate allocations."""
-        x0 = slots[0][0].x
-        tail = x0.shape[1:]
-        dtype = np.result_type(*[p.x.dtype for p, _, _ in slots]) \
-            if len(slots) > 1 else x0.dtype
+        """Coalesce request rows directly into pooled padded bucket
+        buffers — ONE copy per input stream, no intermediate
+        concatenate allocations. Multi-input requests fill one buffer
+        per network input; every request in the batch must carry the
+        same input arity."""
+        n_inputs = len(slots[0][0].xs)
+        if any(len(p.xs) != n_inputs for p, _, _ in slots):
+            raise ValueError(
+                "mixed input arity in one batch: all requests to a "
+                f"model must carry {n_inputs} input(s)")
         bucket = self._bucket(rows)
-        key = (bucket, tail, np.dtype(dtype).str)
-        buf = self._get_buffer(key)
-        ofs = 0
-        for p, src, n in slots:
-            buf[ofs:ofs + n] = p.x[src:src + n]
-            ofs += n
-        if bucket > rows:
-            buf[rows:bucket] = 0   # pooled buffers carry stale rows
-        return key, buf
+        keys: List[tuple] = []
+        bufs: List[np.ndarray] = []
+        for i in range(n_inputs):
+            x0 = slots[0][0].xs[i]
+            tail = x0.shape[1:]
+            dtype = np.result_type(*[p.xs[i].dtype
+                                     for p, _, _ in slots]) \
+                if len(slots) > 1 else x0.dtype
+            key = (bucket, tail, np.dtype(dtype).str)
+            buf = self._get_buffer(key)
+            ofs = 0
+            for p, src, n in slots:
+                buf[ofs:ofs + n] = p.xs[i][src:src + n]
+                ofs += n
+            if bucket > rows:
+                buf[rows:bucket] = 0   # pooled buffers carry stale rows
+            keys.append(key)
+            bufs.append(buf)
+        return keys, bufs
 
     def _batch_loop(self):
         try:
@@ -570,7 +658,7 @@ class ParallelInference:
                     except Exception:   # noqa: BLE001 - telemetry
                         dspan = None
                 try:
-                    key, buf = self._assemble(slots, rows)
+                    keys, bufs = self._assemble(slots, rows)
                 except Exception as e:   # per-batch: propagate to callers
                     for p, _, _ in slots:
                         p.resolve(e)
@@ -582,11 +670,12 @@ class ParallelInference:
                         # async dispatch: hand the in-flight device value
                         # to the completion stage; do NOT block on the
                         # host fetch here
-                        out = self.net.output(jnp.asarray(buf))
+                        out = self.net.output(
+                            *[jnp.asarray(b) for b in bufs])
                 except Exception as e:   # per-batch: propagate to callers
                     for p, _, _ in slots:
                         p.resolve(e)
-                    self._put_buffer(key, buf)
+                    self._put_buffers(keys, bufs)
                     if dspan is not None:
                         dspan.end(error=type(e).__name__)
                     continue
@@ -601,9 +690,9 @@ class ParallelInference:
                     dspan.end()
                 self._adapt_wait(rows)
                 if self._completer is None:
-                    self._complete_batch(out, slots, key, buf, dspan)
+                    self._complete_batch(out, slots, keys, bufs, dspan)
                 else:
-                    self._submit_inflight((out, slots, key, buf, dspan))
+                    self._submit_inflight((out, slots, keys, bufs, dspan))
         except BaseException as e:   # noqa: BLE001 - loop-level death
             # assembler death is a degradation event, not a hang: record
             # it (flips `healthy` and /healthz), then fail every waiter
@@ -619,31 +708,31 @@ class ParallelInference:
         """Bounded in-flight window: block until the completion stage
         frees a slot (backpressure), never past stop/death."""
         while True:
-            if self._stop.is_set() or self._failure is not None or (
-                    self._completer is not None
-                    and not self._completer.is_alive()):
-                _, slots, key, buf, _ = item
+            if self._stop.is_set() or self._failure is not None or any(
+                    not t.is_alive() for t in self._completers):
+                _, slots, keys, bufs, _ = item
                 err = self._unavailable_error() \
                     if not self._stop.is_set() else ShutdownError(
                         "ParallelInference shut down with requests "
                         "in flight")
                 for p, _, _ in slots:
                     p.resolve(err)
-                self._put_buffer(key, buf)
+                self._put_buffers(keys, bufs)
                 return
             if self._inflight_n >= self.pipeline_depth:
                 self._slot_free.clear()
                 if self._inflight_n >= self.pipeline_depth:
                     self._slot_free.wait(timeout=0.05)
                 continue
-            self._inflight_n += 1
+            with self._count_lock:
+                self._inflight_n += 1
             _obs.set_gauge("dl4j_serving_inflight_batches",
                            self._inflight_n)
             self._inflight.put(item)
             return
 
     # ------------------------------------------------------- completion
-    def _complete_batch(self, out, slots: List[_Slot], key, buf,
+    def _complete_batch(self, out, slots: List[_Slot], keys, bufs,
                         dspan=None):
         # completion-stage span: parented to the assembler's dispatch
         # span — a cross-THREAD edge when the completer is running
@@ -655,26 +744,36 @@ class ParallelInference:
                     args={"slots": len(slots)})
             except Exception:   # noqa: BLE001 - telemetry best-effort
                 cspan = None
+        multi = isinstance(out, (list, tuple))
+        outs = list(out) if multi else [out]
+        hosts: List[np.ndarray] = []
         try:
-            host = np.asarray(out)   # host fetch: blocks until computed
+            for o in outs:
+                hosts.append(np.asarray(o))  # host fetch: blocks here
         except Exception as e:   # per-batch: propagate to callers
             for p, _, _ in slots:
                 p.resolve(e)
-            self._put_buffer(key, buf)
+            self._put_buffers(keys, bufs)
             if cspan is not None:
                 cspan.end(error=type(e).__name__)
             return
-        if np.may_share_memory(host, buf):
-            # jnp.asarray can zero-copy-alias the staging buffer on CPU
-            # and identity-ish models can echo it back: never hand
-            # callers views into a buffer the pool will overwrite
-            host = host.copy()
-        self._put_buffer(key, buf)   # compute done: buffer reusable
+        for i, h in enumerate(hosts):
+            if any(np.may_share_memory(h, b) for b in bufs):
+                # jnp.asarray can zero-copy-alias the staging buffer on
+                # CPU and identity-ish models can echo it back: never
+                # hand callers views into a buffer the pool will
+                # overwrite
+                hosts[i] = h.copy()
+        self._put_buffers(keys, bufs)   # compute done: buffers reusable
         ofs = 0
+        done = 0
         for p, src, n in slots:
-            if p.deliver(src, host[ofs:ofs + n]):
-                self._requests_completed += 1
+            if p.deliver(src, [h[ofs:ofs + n] for h in hosts], multi):
+                done += 1
             ofs += n
+        if done:
+            with self._count_lock:   # k completers share this counter
+                self._requests_completed += done
         if cspan is not None:
             cspan.end()
 
@@ -691,7 +790,8 @@ class ParallelInference:
                 try:
                     self._complete_batch(*item)
                 finally:
-                    self._inflight_n -= 1
+                    with self._count_lock:
+                        self._inflight_n -= 1
                     _obs.set_gauge("dl4j_serving_inflight_batches",
                                    self._inflight_n)
                     self._slot_free.set()

@@ -8,7 +8,8 @@ stay out of scope (VERDICT r4); the serving surface itself is plain
 HTTP+JSON like the nearest-neighbor microservice
 (clustering/server.py), so the round-trip is testable anywhere.
 
-Routes:
+Routes (single-model compatibility surface — routes to the registry's
+default model):
   POST /predict  {"inputs": [[...], ...]}          -> {"outputs": [...]}
   POST /predict  {"inputs": ..., "decode_top": 5}  -> adds "decoded"
                  (requires an ImageNetLabels source; zoo/util/imagenet)
@@ -17,19 +18,45 @@ Routes:
   GET  /metrics  -> Prometheus text exposition of the global
                  MetricsRegistry (training, serving, checkpoint, and
                  resilience domains — one scrape covers the process)
-  GET  /healthz  -> liveness: 200 while the batcher is alive, 503 after
-                 it dies or the server shuts down
+  GET  /healthz  -> liveness: 200 while every active model's batcher is
+                 alive, 503 after one dies or the server shuts down
   GET  /readyz   -> readiness: 200 only while accepting traffic
 
+Multi-model control plane (serving/ModelRegistry behind the same
+server — every model × version has its own warmed ParallelInference):
+  POST   /v1/models/<name>/predict      predict on the ACTIVE version;
+                 body may carry {"tenant": ...} (or X-Tenant header)
+                 for admission, and "inputs" may be a dict of named
+                 input streams for multi-input graphs
+  GET    /v1/models                     catalog: every model, version,
+                 lifecycle state, active/previous pointers
+  GET    /v1/models/<name>/status       per-model pipeline/trace facts
+  PUT    /v1/models/<name>/versions/<v> {"path": zip, "activate": true}
+                 load a model zip through the integrity-checked
+                 serializer (corrupted uploads are REJECTED, 409) and
+                 hot-swap with zero downtime
+  POST   /v1/models/<name>/swap         {"version": v} activate a
+                 loaded standby version
+  POST   /v1/models/<name>/rollback     one-call flip to the previous
+                 (still-warm) version
+  DELETE /v1/models/<name>/versions/<v> retire a non-active version
+  DELETE /v1/models/<name>              remove the model entirely
+
 Failure taxonomy (resilience subsystem) instead of blanket 400:
-  404 unknown route - 400 malformed payload / client error
+  404 unknown route / unknown model or version
+  400 malformed payload / client error
+  429 + Retry-After tenant quota exhausted or priority class shed
+  409 lifecycle conflict (delete active, swap to retired) or a
+      corrupted upload failing integrity checks
   503 + Retry-After overload, shutdown, or dead batcher
   500 model/handler crash
 Every error body is {"error": msg, "error_class": ExceptionName}.
 
-Requests are funneled through ParallelInference in BATCHED mode, so
-concurrent small clients coalesce into full MXU tiles (the reference's
-BatchedInferenceObservable role).
+Requests are funneled through each model's ParallelInference in
+BATCHED mode, so concurrent small clients coalesce into full MXU tiles
+(the reference's BatchedInferenceObservable role); the tenant
+AdmissionController (serving/admission.py) sheds the lowest priority
+class first before the bounded queue fills.
 """
 
 from __future__ import annotations
@@ -51,16 +78,24 @@ from deeplearning4j_tpu.parallel.inference import (
     ParallelInference,
 )
 from deeplearning4j_tpu.resilience.errors import (
+    CheckpointIntegrityError,
     CircuitOpenError,
     DeadlineExceededError,
     InferenceUnavailableError,
+    ModelNotFoundError,
     OverloadedError,
+    QuotaExceededError,
     RetriesExhaustedError,
     ServingError,
     ShutdownError,
 )
 from deeplearning4j_tpu.resilience.faults import fire as _fire
 from deeplearning4j_tpu.resilience.retry import CircuitBreaker, Retry
+
+# NOTE: the control-plane classes (ModelRegistry, AdmissionController)
+# are imported lazily inside ModelServer.__init__ — serving/registry.py
+# imports the parallel package, so a module-level import here would be
+# circular from either entry point.
 
 # errors that mean "back off and retry": surfaced as 503 + Retry-After
 _UNAVAILABLE = (OverloadedError, ShutdownError, InferenceUnavailableError,
@@ -72,29 +107,59 @@ class _ClientError(ValueError):
 
 
 class ModelServer:
-    """Serve a trained MultiLayerNetwork/ComputationGraph over HTTP.
+    """Serve trained MultiLayerNetwork/ComputationGraph models over
+    HTTP.
 
-    `labels` (optional ImageNetLabels) enables decoded top-k responses
-    — the user-facing half of the zoo (`decode_predictions`)."""
+    Single-model compatibility: `ModelServer(net)` registers `net` as
+    the registry's default model and every PR 1-5 route (/predict,
+    /status, probes) behaves exactly as before. Multi-model: pass
+    `registry=` (a serving.ModelRegistry) or keep registering models on
+    `server.registry` — each model × version gets its own warmed
+    ParallelInference and the /v1/models routes drive the lifecycle.
 
-    def __init__(self, net, port: int = 0, host: str = "127.0.0.1",
+    `tenants` ({name: {"rate": ..., "burst": ..., "priority": ...}} or
+    {name: TenantConfig}) arms the admission layer: per-tenant token
+    buckets and priority classes, lowest class shed first under queue
+    pressure. `labels` (optional ImageNetLabels) enables decoded top-k
+    responses — the user-facing half of the zoo
+    (`decode_predictions`)."""
+
+    def __init__(self, net=None, port: int = 0, host: str = "127.0.0.1",
                  inference_mode: str = InferenceMode.BATCHED,
                  batch_limit: int = 32, labels=None,
                  output_activation: bool = True,
                  pipeline_depth: int = 2, warmup: bool = True,
                  max_wait_ms: float = 2.0, adaptive_wait: bool = True,
-                 tracer=None):
-        self._owns_pi = not isinstance(net, ParallelInference)
-        self.pi = (net if not self._owns_pi
-                   else ParallelInference(net, inference_mode,
-                                          batch_limit=batch_limit,
-                                          pipeline_depth=pipeline_depth,
-                                          warmup=warmup,
-                                          max_wait_ms=max_wait_ms,
-                                          adaptive_wait=adaptive_wait,
-                                          tracer=tracer))
+                 tracer=None, registry=None, admission=None,
+                 tenants=None, model_name: str = "default",
+                 queue_limit: int = 64):
+        from deeplearning4j_tpu.serving.admission import (
+            AdmissionController,
+            TenantConfig,
+        )
+        from deeplearning4j_tpu.serving.registry import ModelRegistry
+
+        self._owns_registry = registry is None
+        self.registry = registry if registry is not None else \
+            ModelRegistry(inference_mode=inference_mode,
+                          batch_limit=batch_limit,
+                          queue_limit=queue_limit,
+                          pipeline_depth=pipeline_depth,
+                          warmup=warmup, max_wait_ms=max_wait_ms,
+                          adaptive_wait=adaptive_wait, tracer=tracer)
+        if net is not None:
+            self.registry.register(model_name, net)
+        if admission is not None:
+            self.admission = admission
+        elif tenants:
+            self.admission = AdmissionController(
+                {n: (t if isinstance(t, TenantConfig)
+                     else TenantConfig.from_dict(n, t))
+                 for n, t in tenants.items()})
+        else:
+            self.admission = None
         self.tracer = tracer if tracer is not None \
-            else getattr(self.pi, "tracer", None)
+            else getattr(self._default_pi(), "tracer", None)
         self.labels = labels
         self.host = host
         self.port = port
@@ -105,47 +170,153 @@ class ModelServer:
         self._ready = False
         self._t0 = time.monotonic()
 
-    # ------------------------------------------------------------ handlers
-    def _handle_predict(self, req: dict) -> dict:
+    # --------------------------------------------------------- plumbing
+    @property
+    def pi(self):
+        """The default model's ACTIVE ParallelInference (the PR 1-5
+        single-model surface)."""
+        return self._default_pi()
+
+    def _default_pi(self):
         try:
-            x = np.asarray(req["inputs"], np.float32)
+            e = self.registry.default_entry()
+            with e._lock:
+                return e.versions[e.active].pi if e.active else None
+        except ModelNotFoundError:
+            return None
+
+    def _healthy(self) -> bool:
+        return self.registry.healthy()
+
+    # ------------------------------------------------------------ handlers
+    @staticmethod
+    def _request_arrays(req: dict, pi) -> list:
+        """The request's input arrays: a bare array for single-input
+        models, or a dict of named streams ordered by the graph's
+        network_inputs for multi-input graphs."""
+        try:
+            inputs = req["inputs"]
         except KeyError:
             raise _ClientError("missing required field 'inputs'") from None
+        try:
+            if isinstance(inputs, dict):
+                names = getattr(getattr(pi.net, "conf", None),
+                                "network_inputs", None) or \
+                    sorted(inputs)
+                missing = [n for n in names if n not in inputs]
+                if missing:
+                    raise _ClientError(
+                        f"missing named inputs {missing} "
+                        f"(model wants {list(names)})")
+                xs = [np.asarray(inputs[n], np.float32) for n in names]
+            else:
+                xs = [np.asarray(inputs, np.float32)]
+        except _ClientError:
+            raise
         except (TypeError, ValueError) as e:
             raise _ClientError(f"bad 'inputs': {e}") from None
         if req.get("single", False):
-            x = x[None, ...]   # one unbatched example
+            xs = [x[None, ...] for x in xs]   # one unbatched example
+        return xs
+
+    def _handle_predict(self, req: dict, model: Optional[str] = None,
+                        tenant: Optional[str] = None) -> dict:
+        entry = (self.registry.entry(model) if model is not None
+                 else self.registry.default_entry())
+        tenant = tenant or req.get("tenant")
         top = int(req.get("decode_top", 0))
         if top > 0 and self.labels is None:
             raise _ClientError(
                 "server started without labels; decode_top unavailable")
-        out = np.asarray(self.pi.output(x))
+        # the lease pins ONE (version, pi) pair: a hot-swap between
+        # admission and response is invisible to this request
+        with entry.lease() as (version, pi):
+            if self.admission is not None:
+                self.admission.admit(tenant, entry.name,
+                                     pi.queue_depth(), pi.queue_limit)
+            xs = self._request_arrays(req, pi)
+            out = pi.output(*xs)
+            _obs.count("dl4j_serving_model_requests_total",
+                       labels={"model": entry.name, "version": version})
         with self._served_lock:
-            self._served += x.shape[0]
-        resp = {"outputs": out.tolist()}
-        if top > 0:
+            self._served += xs[0].shape[0]
+        multi = isinstance(out, list)
+        resp = {
+            "outputs": ([np.asarray(o).tolist() for o in out]
+                        if multi else np.asarray(out).tolist()),
+            "model": entry.name,
+            "version": version,
+        }
+        if multi:
+            resp["multi_output"] = True
+        if top > 0 and not multi:
+            out = np.asarray(out)
             resp["decoded"] = [
                 [{"class": c, "wnid": w, "label": l, "probability": p}
                  for (c, w, l, p) in row]
                 for row in self.labels.decode_predictions(out, top=top)]
         return resp
 
+    # ------------------------------------------------- lifecycle routes
+    def _handle_put_version(self, model: str, version: str,
+                            req: dict) -> dict:
+        path = req.get("path")
+        if not path or not isinstance(path, str):
+            raise _ClientError(
+                "body must carry 'path': a server-readable model zip")
+        self.registry.load_version(
+            model, version, path,
+            model_type=req.get("model_type", "auto"),
+            activate=bool(req.get("activate", True)),
+            warmup_inputs=req.get("warmup_inputs"))
+        return {"model": model, "version": version,
+                "active": self.registry.entry(model).active}
+
+    def _handle_model_command(self, model: str, command: str,
+                              req: dict) -> dict:
+        if command == "rollback":
+            version = self.registry.rollback(model)
+        elif command == "swap":
+            version = req.get("version")
+            if not version:
+                raise _ClientError("swap needs 'version' in the body")
+            self.registry.swap(model, version)
+        else:
+            raise ModelNotFoundError(f"no model command {command!r}")
+        return {"model": model,
+                "active": self.registry.entry(model).active,
+                "previous": self.registry.entry(model).previous}
+
+    # ----------------------------------------------------------- status
     def _status_facts(self) -> dict:
+        pi = self._default_pi()
+        entry = None
+        try:
+            entry = self.registry.default_entry()
+        except ModelNotFoundError:
+            pass
         facts = {
-            "model": type(self.pi.net).__name__,
-            "inference_mode": self.pi.mode,
-            "batch_limit": self.pi.batch_limit,
+            "model": (type(pi.net).__name__ if pi is not None
+                      else None),
+            "default_model": self.registry.default_model,
+            "version": (entry.active if entry is not None else None),
+            "models": self.registry.model_names(),
+            "inference_mode": (pi.mode if pi is not None else None),
+            "batch_limit": (pi.batch_limit if pi is not None else None),
             "served": self._served,
-            "queue_depth": self.pi.queue_depth(),
-            "healthy": self.pi.healthy,
-            "ready": self._ready and self.pi.healthy,
+            "queue_depth": (pi.queue_depth() if pi is not None else 0),
+            "healthy": self._healthy(),
+            "ready": self._ready and self._healthy(),
             "has_labels": self.labels is not None}
         # pipelined data-plane + compile-once guard facts: bucket
         # warmup, trace/recompile counters, adaptive-wait state
-        facts["pipeline"] = self.pi.stats()
-        trace = self.pi.trace_stats()
-        facts["trace_counts"] = trace.get("trace_counts", {})
-        facts["total_traces"] = trace.get("total_traces", 0)
+        if pi is not None:
+            facts["pipeline"] = pi.stats()
+            trace = pi.trace_stats()
+            facts["trace_counts"] = trace.get("trace_counts", {})
+            facts["total_traces"] = trace.get("total_traces", 0)
+        if self.admission is not None:
+            facts["admission"] = self.admission.stats()
         # telemetry facts (observability/): uptime + the registry's
         # monotonic request/error counters (process-wide, survive
         # across this server's construction), plus span-buffer facts
@@ -167,11 +338,13 @@ class ModelServer:
     def _metrics_text(self) -> str:
         """The GET /metrics body: refresh the pull-style gauges from
         the live front-end, then render the whole registry."""
-        _obs.set_gauge("dl4j_serving_queue_depth",
-                       self.pi.queue_depth())
-        trace = self.pi.trace_stats()
-        _obs.set_gauge("dl4j_jit_traces_total",
-                       trace.get("total_traces", 0))
+        pi = self._default_pi()
+        if pi is not None:
+            _obs.set_gauge("dl4j_serving_queue_depth",
+                           pi.queue_depth())
+            trace = pi.trace_stats()
+            _obs.set_gauge("dl4j_jit_traces_total",
+                           trace.get("total_traces", 0))
         return get_registry().prometheus_text()
 
     # --------------------------------------------------------------- start
@@ -207,8 +380,67 @@ class ModelServer:
                                   "error_class": type(exc).__name__},
                            headers)
 
+            def _send_404(self):
+                self._send(404, {"error": f"no route {self.path}",
+                                 "error_class": "NotFound"})
+
+            def _read_body(self) -> dict:
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n).decode() if n else "{}"
+                try:
+                    req = json.loads(raw or "{}")
+                except ValueError as e:
+                    raise _ClientError(f"malformed JSON body: {e}") \
+                        from None
+                if not isinstance(req, dict):
+                    raise _ClientError("body must be a JSON object")
+                return req
+
+            @staticmethod
+            def _model_route(path):
+                """('name', 'cmd', 'ver') from /v1/models/... paths;
+                None when the path is not under /v1/models."""
+                parts = [p for p in path.split("/") if p]
+                if len(parts) < 2 or parts[0] != "v1" \
+                        or parts[1] != "models":
+                    return None
+                name = parts[2] if len(parts) > 2 else None
+                cmd = parts[3] if len(parts) > 3 else None
+                ver = parts[4] if len(parts) > 4 else None
+                return name, cmd, ver
+
+            def _guarded(self, fn, value_error_code=400):
+                """Run a handler under the full error taxonomy.
+                `value_error_code` routes bare ValueErrors: 400 on data
+                routes (bad request payloads), 409 on lifecycle routes
+                (swap/delete conflicts)."""
+                try:
+                    return fn()
+                except _ClientError as e:
+                    self._send_error(400, e)
+                except ModelNotFoundError as e:
+                    self._send_error(404, e)
+                except QuotaExceededError as e:
+                    retry_after = getattr(e, "retry_after_s", 1.0) or 1.0
+                    self._send_error(
+                        429, e,
+                        [("Retry-After", f"{max(1, int(retry_after))}")])
+                except CheckpointIntegrityError as e:
+                    # rejected corrupt/torn uploads
+                    self._send_error(409, e)
+                except ValueError as e:
+                    self._send_error(value_error_code, e)
+                except _UNAVAILABLE as e:
+                    retry_after = getattr(e, "retry_after_s", 1.0) or 1.0
+                    self._send_error(
+                        503, e,
+                        [("Retry-After", f"{max(1, int(retry_after))}")])
+                except Exception as e:   # noqa: BLE001 - HTTP boundary
+                    self._send_error(500, e)
+
             def do_GET(self):
                 path = self.path.rstrip("/")
+                route = self._model_route(path)
                 if path == "/status":
                     self._send(200, server._status_facts())
                 elif path == "/metrics":
@@ -217,53 +449,93 @@ class ModelServer:
                         200, server._metrics_text(),
                         "text/plain; version=0.0.4; charset=utf-8")
                 elif path == "/healthz":
-                    if server.pi.healthy:
+                    if server._healthy():
                         self._send(200, {"status": "ok"})
                     else:
                         self._send(503, {"status": "unhealthy",
                                          "healthy": False},
                                    [("Retry-After", "1")])
                 elif path == "/readyz":
-                    if server._ready and server.pi.healthy:
+                    if server._ready and server._healthy():
                         self._send(200, {"status": "ready"})
                     else:
                         self._send(503, {"status": "not ready"},
                                    [("Retry-After", "1")])
+                elif route is not None:
+                    name, cmd, _ = route
+                    if name is None:
+                        self._send(200, server.registry.models_status())
+                    elif cmd == "status":
+                        self._guarded(lambda: self._send(
+                            200, server.registry.entry(name).status()))
+                    else:
+                        self._send_404()
                 else:
-                    self._send(404, {"error": f"no route {self.path}",
-                                     "error_class": "NotFound"})
+                    self._send_404()
 
-            def do_POST(self):
-                path = self.path.rstrip("/")
-                if path != "/predict":
-                    self._send(404, {"error": f"no route {self.path}",
-                                     "error_class": "NotFound"})
-                    return
+            def _predict(self, model):
                 _obs.count("dl4j_serving_requests_total")
                 t0 = time.perf_counter()
-                try:
+
+                def _run():
                     _fire("serve.request")
-                    n = int(self.headers.get("Content-Length", 0))
-                    try:
-                        req = json.loads(self.rfile.read(n).decode())
-                    except ValueError as e:
-                        raise _ClientError(f"malformed JSON body: {e}") \
-                            from None
-                    if not isinstance(req, dict):
-                        raise _ClientError("body must be a JSON object")
-                    resp = server._handle_predict(req)
+                    req = self._read_body()
+                    resp = server._handle_predict(
+                        req, model=model,
+                        tenant=self.headers.get("X-Tenant"))
                     _obs.observe("dl4j_serving_request_seconds",
                                  time.perf_counter() - t0)
                     self._send(200, resp)
-                except _ClientError as e:
-                    self._send_error(400, e)
-                except _UNAVAILABLE as e:
-                    retry_after = getattr(e, "retry_after_s", 1.0) or 1.0
-                    self._send_error(
-                        503, e,
-                        [("Retry-After", f"{max(1, int(retry_after))}")])
-                except Exception as e:   # noqa: BLE001 - HTTP boundary
-                    self._send_error(500, e)
+
+                self._guarded(_run)
+
+            def do_POST(self):
+                path = self.path.rstrip("/")
+                route = self._model_route(path)
+                if path == "/predict":
+                    self._predict(None)
+                elif route is not None and route[1] == "predict":
+                    self._predict(route[0])
+                elif route is not None and route[1] in ("rollback",
+                                                        "swap"):
+                    name, cmd, _ = route
+                    self._guarded(lambda: self._send(
+                        200, server._handle_model_command(
+                            name, cmd, self._read_body())),
+                        value_error_code=409)
+                else:
+                    self._send_404()
+
+            def do_PUT(self):
+                route = self._model_route(self.path.rstrip("/"))
+                if route is None or route[1] != "versions" \
+                        or route[2] is None:
+                    self._send_404()
+                    return
+                name, _, ver = route
+                self._guarded(lambda: self._send(
+                    200, server._handle_put_version(
+                        name, ver, self._read_body())),
+                    value_error_code=409)
+
+            def do_DELETE(self):
+                route = self._model_route(self.path.rstrip("/"))
+                if route is None or route[0] is None:
+                    self._send_404()
+                    return
+                name, cmd, ver = route
+
+                def _run():
+                    if cmd == "versions" and ver is not None:
+                        server.registry.delete_version(name, ver)
+                        self._send(200, {"model": name, "deleted": ver})
+                    elif cmd is None:
+                        server.registry.remove(name)
+                        self._send(200, {"deleted": name})
+                    else:
+                        self._send_404()
+
+                self._guarded(_run, value_error_code=409)
 
             def log_message(self, *a):
                 pass
@@ -286,8 +558,10 @@ class ModelServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
-        if self._owns_pi:   # never kill a caller-supplied front-end
-            self.pi.shutdown()
+        if self._owns_registry:
+            # the registry shuts down only the ParallelInference
+            # front-ends it built — never a caller-supplied one
+            self.registry.shutdown()
 
 
 _DEFAULT_BREAKER = object()   # sentinel: "construct the default breaker"
@@ -360,7 +634,8 @@ class ModelClient:
             raise exc
         return result
 
-    def _request(self, route: str, payload: Optional[dict] = None) -> dict:
+    def _request(self, route: str, payload: Optional[dict] = None,
+                 method: Optional[str] = None) -> dict:
         import urllib.error
         import urllib.request
 
@@ -368,7 +643,7 @@ class ModelClient:
             data = (json.dumps(payload).encode()
                     if payload is not None else None)
             req = urllib.request.Request(
-                self.url + route, data=data,
+                self.url + route, data=data, method=method,
                 headers={"Content-Type": "application/json"})
             try:
                 with urllib.request.urlopen(req,
@@ -397,14 +672,63 @@ class ModelClient:
     def _post(self, route: str, payload: dict) -> dict:
         return self._request(route, payload)
 
-    def predict(self, inputs, decode_top: int = 0) -> dict:
-        payload = {"inputs": np.asarray(inputs).tolist()}
+    def predict(self, inputs, decode_top: int = 0,
+                model: Optional[str] = None,
+                tenant: Optional[str] = None) -> dict:
+        """POST /predict, or /v1/models/<model>/predict when `model`
+        is given. `inputs` may be an array or (for multi-input graphs)
+        a dict of named input streams; `tenant` rides in the body for
+        the server's admission layer."""
+        if isinstance(inputs, dict):
+            payload = {"inputs": {k: np.asarray(v).tolist()
+                                  for k, v in inputs.items()}}
+        else:
+            payload = {"inputs": np.asarray(inputs).tolist()}
         if decode_top:
             payload["decode_top"] = decode_top
-        return self._request("/predict", payload)
+        if tenant is not None:
+            payload["tenant"] = tenant
+        route = (f"/v1/models/{model}/predict" if model is not None
+                 else "/predict")
+        return self._request(route, payload)
 
-    def status(self) -> dict:
+    def status(self, model: Optional[str] = None) -> dict:
+        if model is not None:
+            return self._request(f"/v1/models/{model}/status")
         return self._request("/status")
+
+    # --------------------------------------------- model lifecycle
+    def models(self) -> dict:
+        """GET /v1/models — the registry catalog."""
+        return self._request("/v1/models")
+
+    def put_version(self, model: str, version: str, path: str,
+                    activate: bool = True, model_type: str = "auto",
+                    warmup_inputs=None) -> dict:
+        """PUT /v1/models/<model>/versions/<version> — load a model
+        zip (server-side path) through the integrity-checked
+        serializer and optionally hot-swap to it."""
+        payload = {"path": path, "activate": activate,
+                   "model_type": model_type}
+        if warmup_inputs is not None:
+            payload["warmup_inputs"] = [list(s) for s in warmup_inputs]
+        return self._request(
+            f"/v1/models/{model}/versions/{version}", payload,
+            method="PUT")
+
+    def swap(self, model: str, version: str) -> dict:
+        return self._request(f"/v1/models/{model}/swap",
+                             {"version": version})
+
+    def rollback(self, model: str) -> dict:
+        return self._request(f"/v1/models/{model}/rollback", {})
+
+    def delete_version(self, model: str, version: str) -> dict:
+        return self._request(
+            f"/v1/models/{model}/versions/{version}", method="DELETE")
+
+    def delete_model(self, model: str) -> dict:
+        return self._request(f"/v1/models/{model}", method="DELETE")
 
     def metrics(self) -> dict:
         """GET /metrics parsed into {sample_name[{labels}]: value} —

@@ -18,9 +18,12 @@ from deeplearning4j_tpu.resilience.errors import (
     DeadlineExceededError,
     FaultInjectedError,
     InferenceUnavailableError,
+    ModelNotFoundError,
+    NoHealthyReplicaError,
     NonFiniteLossError,
     OverloadedError,
     PreemptedError,
+    QuotaExceededError,
     ResilienceError,
     RestartsExhaustedError,
     RetriesExhaustedError,
@@ -72,10 +75,11 @@ from deeplearning4j_tpu.resilience.cluster import (
 __all__ = [
     "CheckpointIntegrityError", "CircuitOpenError",
     "DeadlineExceededError", "FaultInjectedError",
-    "InferenceUnavailableError", "NonFiniteLossError", "OverloadedError",
-    "PreemptedError", "ResilienceError", "RestartsExhaustedError",
-    "RetriesExhaustedError", "ServingError", "ShutdownError",
-    "StepHangError",
+    "InferenceUnavailableError", "ModelNotFoundError",
+    "NoHealthyReplicaError", "NonFiniteLossError", "OverloadedError",
+    "PreemptedError", "QuotaExceededError", "ResilienceError",
+    "RestartsExhaustedError", "RetriesExhaustedError", "ServingError",
+    "ShutdownError", "StepHangError",
     "FAULTS_ENV_VAR", "REGISTERED_POINTS", "FaultInjector", "FaultSpec",
     "fire", "injector",
     "CircuitBreaker", "Retry",

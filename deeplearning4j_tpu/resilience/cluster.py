@@ -135,10 +135,12 @@ class HeartbeatFile:
     `write()` atomically replaces the record (tmp + os.replace — no
     fsync: heartbeats are advisory, a torn one just looks stale) and is
     throttled to one disk write per `min_interval_s` unless the status
-    changes or `force=True`. The supervisor reads the file's mtime as
-    the lease timestamp, so a worker that stops calling write() —
-    wedged, killed, or swallowed by a native collective — goes stale
-    without any cooperation from the worker."""
+    changes or `force=True`. The supervisor reads the wall-clock
+    `time` field embedded in the record as the lease timestamp
+    (immune to coarse-mtime filesystems like NFS), falling back to the
+    file's mtime for torn/unparseable records — so a worker that stops
+    calling write() — wedged, killed, or swallowed by a native
+    collective — goes stale without any cooperation from the worker."""
 
     def __init__(self, path: str, min_interval_s: float = 0.2):
         self.path = path
@@ -200,12 +202,27 @@ class HeartbeatFile:
     @staticmethod
     def age_s(path: str) -> Optional[float]:
         """Seconds since the lease was last renewed (None = no lease
-        yet). mtime-based, so even a torn/unparseable record counts as
-        a renewal — writes prove the process is alive."""
+        yet).
+
+        Staleness reads the wall-clock `time` field EMBEDDED in the
+        record — on NFS-style filesystems with coarse (whole-second or
+        worse) mtime granularity, mtime alone inflates the age and
+        fires false stale-lease kills. A torn/unparseable record still
+        counts as a renewal via the mtime fallback: any write proves
+        the process is alive."""
         try:
-            return max(0.0, time.time() - os.path.getmtime(path))
+            mtime_age = max(0.0, time.time() - os.path.getmtime(path))
         except OSError:
             return None
+        rec = HeartbeatFile.read(path)
+        t = rec.get("time") if isinstance(rec, dict) else None
+        if isinstance(t, (int, float)):
+            rec_age = time.time() - float(t)
+            if rec_age >= 0.0:
+                return rec_age
+            # record timestamp in the future = writer clock skew;
+            # trust mtime rather than reporting a forever-fresh lease
+        return mtime_age
 
 
 class _Member:

@@ -102,6 +102,34 @@ class RestartsExhaustedError(ResilienceError):
         self.ledger = ledger or []
 
 
+class QuotaExceededError(ResilienceError):
+    """A tenant's token-bucket quota is spent (or its priority class
+    was shed under queue pressure before reaching the bounded queue).
+    Maps to HTTP 429 + Retry-After — distinct from OverloadedError
+    (503), which means the SERVER is saturated, not the tenant."""
+
+    def __init__(self, msg: str, tenant: str = "",
+                 retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.tenant = tenant
+        self.retry_after_s = retry_after_s
+
+
+class ModelNotFoundError(ResilienceError):
+    """The registry has no model (or no such version) under that name.
+    Maps to HTTP 404 on the /v1/models routes."""
+
+
+class NoHealthyReplicaError(ResilienceError):
+    """Every replica behind a ReplicaRouter is open-circuited or
+    failed the request — there is nowhere left to fail over to.
+    `cause` is the last replica's failure."""
+
+    def __init__(self, msg: str, cause: Exception | None = None):
+        super().__init__(msg)
+        self.cause = cause
+
+
 class ServingError(ResilienceError):
     """HTTP error surfaced by ModelClient with the server's own story.
 

@@ -1,0 +1,38 @@
+"""Serving control plane above the ParallelInference data plane.
+
+PRs 1-2 built a single-model data plane (pipelined batching, bounded
+queues, deadlines, integrity-checked persistence); this package is the
+control plane that makes it multi-model and multi-tenant:
+
+  registry.py   ModelRegistry — N named models × versions, verified
+                loads, zero-downtime hot-swap, one-call rollback,
+                background drain/retire;
+  admission.py  AdmissionController — per-tenant token-bucket quotas +
+                priority classes with shed-lowest-first load shedding
+                in front of the bounded queue;
+  router.py     ReplicaRouter — client-side least-outstanding spreading
+                over N ModelServer replicas with CircuitBreaker health
+                and automatic failover.
+
+The HTTP surface (the /v1/models routes) lives on ModelServer in
+parallel/serving.py, which consumes all three.
+"""
+
+from deeplearning4j_tpu.serving.admission import (  # noqa: F401
+    DEFAULT_SHED_THRESHOLDS,
+    PRIORITY_CLASSES,
+    AdmissionController,
+    TenantConfig,
+    TokenBucket,
+)
+from deeplearning4j_tpu.serving.registry import (  # noqa: F401
+    ModelEntry,
+    ModelRegistry,
+)
+from deeplearning4j_tpu.serving.router import ReplicaRouter  # noqa: F401
+
+__all__ = [
+    "DEFAULT_SHED_THRESHOLDS", "PRIORITY_CLASSES",
+    "AdmissionController", "TenantConfig", "TokenBucket",
+    "ModelEntry", "ModelRegistry", "ReplicaRouter",
+]

@@ -1,0 +1,168 @@
+"""ReplicaRouter: client-side spreading over N ModelServer replicas.
+
+The scale-out half of the serving story: one logical client over many
+server URLs. Per-replica health is the CircuitBreaker already wired
+into every ModelClient (503s/connection failures trip it; any response
+proves liveness); the router adds:
+
+  picking    least-outstanding-requests among replicas whose breaker
+             admits traffic (open circuits are skipped without paying
+             a connection attempt), with round-robin tie-breaking so
+             equal replicas share load;
+  failover   an unavailable-class failure (connection error, retry
+             exhaustion, 503, open circuit) moves the request to the
+             next-best replica automatically — the caller sees one
+             logical call. Responses that prove the server is alive
+             but unhappy (400/404/429/500) surface immediately:
+             another replica would answer the same.
+
+`NoHealthyReplicaError` (with the last failure as `cause`) is raised
+only when every replica has been tried or is open-circuited.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+from deeplearning4j_tpu.observability import metrics as _obs
+from deeplearning4j_tpu.resilience.errors import (
+    CircuitOpenError,
+    NoHealthyReplicaError,
+    RetriesExhaustedError,
+    ServingError,
+)
+
+# NOTE: ModelClient is imported lazily inside _default_factory —
+# parallel/serving.py imports this package for the control-plane
+# classes, so a module-level import here would be circular.
+
+# failures that mean "this REPLICA is unavailable" — fail over.
+_FAILOVER = (ConnectionError, OSError, TimeoutError,
+             RetriesExhaustedError, CircuitOpenError)
+
+
+def _default_factory(timeout: float):
+    from deeplearning4j_tpu.parallel.serving import ModelClient
+
+    return lambda url: ModelClient(url, timeout=timeout)
+
+
+class _Replica:
+    __slots__ = ("url", "client", "outstanding", "requests",
+                 "failures")
+
+    def __init__(self, url: str, client):
+        self.url = url
+        self.client = client
+        self.outstanding = 0
+        self.requests = 0
+        self.failures = 0
+
+
+class ReplicaRouter:
+    """Spread requests across ModelServer replicas with
+    least-outstanding picking and automatic failover.
+
+    `client_factory(url)` defaults to a ModelClient with its stock
+    CircuitBreaker and retry policy; inject a factory to tune either
+    (or to stub replicas in tests)."""
+
+    def __init__(self, urls: List[str], timeout: float = 30.0,
+                 client_factory: Optional[Callable] = None):
+        if not urls:
+            raise ValueError("ReplicaRouter needs at least one URL")
+        factory = client_factory or _default_factory(timeout)
+        self._replicas = [_Replica(u.rstrip("/"), factory(u))
+                          for u in urls]
+        self._lock = threading.Lock()
+        self._rr = 0
+        self.failovers = 0
+
+    # -------------------------------------------------------- picking
+    def _pick(self, exclude: set) -> Optional[_Replica]:
+        """Least outstanding among breaker-admitting replicas not yet
+        tried for this request; round-robin offset breaks ties so
+        idle-equal replicas alternate."""
+        with self._lock:
+            n = len(self._replicas)
+            best, best_key = None, None
+            for i in range(n):
+                r = self._replicas[(self._rr + i) % n]
+                if r.url in exclude:
+                    continue
+                if r.client.breaker is not None \
+                        and not r.client.breaker.allow():
+                    continue
+                key = r.outstanding
+                if best is None or key < best_key:
+                    best, best_key = r, key
+            if best is not None:
+                self._rr = (self._rr + 1) % n
+                best.outstanding += 1
+                best.requests += 1
+            return best
+
+    def _release(self, r: _Replica, failed: bool) -> None:
+        with self._lock:
+            r.outstanding -= 1
+            if failed:
+                r.failures += 1
+
+    # -------------------------------------------------------- calling
+    def _call(self, fn: Callable[[_Replica], dict]) -> dict:
+        tried: set = set()
+        last: Optional[Exception] = None
+        for _ in range(len(self._replicas)):
+            r = self._pick(tried)
+            if r is None:
+                break
+            tried.add(r.url)
+            try:
+                out = fn(r)
+            except _FAILOVER as exc:
+                self._release(r, failed=True)
+                last = exc
+                with self._lock:
+                    self.failovers += 1
+                _obs.count("dl4j_serving_replica_failovers_total")
+                continue
+            except ServingError as exc:
+                self._release(r, failed=exc.retryable)
+                if exc.retryable:   # 503/429: the replica is drowning
+                    last = exc
+                    with self._lock:
+                        self.failovers += 1
+                    _obs.count("dl4j_serving_replica_failovers_total")
+                    continue
+                raise               # 400/404/500: same answer anywhere
+            self._release(r, failed=False)
+            return out
+        raise NoHealthyReplicaError(
+            f"no healthy replica answered (tried {sorted(tried)}; "
+            f"last: {last!r})", cause=last)
+
+    def predict(self, inputs, model: Optional[str] = None,
+                tenant: Optional[str] = None,
+                decode_top: int = 0) -> dict:
+        return self._call(lambda r: r.client.predict(
+            inputs, decode_top=decode_top, model=model, tenant=tenant))
+
+    def status(self, model: Optional[str] = None) -> dict:
+        return self._call(lambda r: r.client.status(model=model))
+
+    # ---------------------------------------------------------- facts
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "failovers": self.failovers,
+                "replicas": [{
+                    "url": r.url,
+                    "outstanding": r.outstanding,
+                    "requests": r.requests,
+                    "failures": r.failures,
+                    "breaker": (r.client.breaker.state
+                                if r.client.breaker is not None
+                                else None),
+                } for r in self._replicas],
+            }

@@ -133,7 +133,9 @@ def test_batches_overlap_dispatch_and_completion():
         for t in threads:
             t.join(timeout=5.0)
         assert len(results) == 2
-        assert net.fetched == [0, 1]  # completions in dispatch order
+        # every dispatch completed; with completion_streams=2 the two
+        # fetches run concurrently, so completion ORDER is unspecified
+        assert sorted(net.fetched) == [0, 1]
     finally:
         net.release.set()
         pi.shutdown()
