@@ -69,6 +69,16 @@ def bench_training(steps=300, reps=12):
         gc.collect()   # a stale pass's garbage must not bill this one
         enable(config != "off")
         tm.tracer = Tracer() if config == "traced" else None
+        if config == "profiled":
+            from deeplearning4j_tpu.observability.perf import (
+                StepPhaseProfiler,
+            )
+
+            # device-sync sampling OFF (sync_every=0): measures the
+            # pure mark+emit cost; sampled syncs are a separate,
+            # deliberate purchase (PERF.md)
+            tm.phase_profiler = StepPhaseProfiler(
+                accumulator=tm._obs_acc, sync_every=0)
         try:
             start = cursor[0]
             t0 = time.perf_counter()
@@ -79,10 +89,11 @@ def bench_training(steps=300, reps=12):
             return steps / dt
         finally:
             tm.tracer = None
+            tm.phase_profiler = None
             enable(True)
 
-    runs = {"on": [], "off": [], "traced": []}
-    pairs = {"on": [], "traced": []}
+    runs = {"on": [], "off": [], "traced": [], "profiled": []}
+    pairs = {"on": [], "traced": [], "profiled": []}
     # session ramp warmup: a cold process climbs ~40% over its first
     # seconds (allocator/branch caches, CPU boost) — run throwaway
     # passes until adjacent passes agree within 3% so the measured
@@ -101,7 +112,7 @@ def bench_training(steps=300, reps=12):
     # 1-core box, so BOTH configs must get enough draws to catch the
     # box's fast windows before best-of converges
     for rep in range(max(4, reps)):
-        for config in ("on", "traced"):
+        for config in ("on", "traced", "profiled"):
             a, b = ((config, "off") if rep % 2 == 0
                     else ("off", config))
             first, second = run(a), run(b)
@@ -119,13 +130,13 @@ def bench_training(steps=300, reps=12):
     # passes ±10%, which drowns a ~1% effect in any averaged estimator)
     out["overhead_pct"] = {
         k: round((1.0 - max(runs[k]) / max(runs["off"])) * 100.0, 2)
-        for k in ("on", "traced")}
+        for k in ("on", "traced", "profiled")}
     # secondary: median of adjacent-pair ratios (the two passes of a
     # pair share the box's transient load) — noisier, kept for honesty
     out["overhead_pct_paired_median"] = {
         k: round(float(np.median(
             [1.0 - a / b for a, b in pairs[k]])) * 100.0, 2)
-        for k in ("on", "traced")}
+        for k in ("on", "traced", "profiled")}
     return out
 
 
@@ -189,12 +200,15 @@ def main():
             "on": round(train["on"], 1),
             "off": round(train["off"], 1),
             "traced": round(train["traced"], 1),
+            "profiled": round(train["profiled"], 1),
             "spread": train["spread"]},
         "train_overhead_pct_cross_median": pct(train["on"],
                                                train["off"]),
         "train_overhead_pct_paired_median":
             train["overhead_pct_paired_median"]["on"],
         "train_traced_overhead_pct": train["overhead_pct"]["traced"],
+        "train_profiled_overhead_pct":
+            train["overhead_pct"]["profiled"],
         "serving_overhead_pct": serve["overhead_pct"],
         "serving_overhead_pct_paired_median":
             serve["overhead_pct_paired_median"],

@@ -163,6 +163,36 @@ def bench_mode(make_net, pipeline_depth, n_requests=600, clients=24,
                 }
         best["batches_dispatched"] = pi.stats()["batches_dispatched"]
         best.update(pi.trace_stats())
+        # cost-model MFU for real nets (observability/perf.py): XLA-
+        # counted flops of the warmed full-bucket predict program,
+        # scaled by achieved rows/sec — stub nets (no JitCache) emit
+        # None, keeping the JSON shape stable across modes.
+        best["mfu_cost_model"] = None
+        cache = getattr(net, "_jit_cache", None)
+        if cache is not None and "predict" in cache:
+            try:
+                import jax
+                import jax.numpy as jnp
+
+                from deeplearning4j_tpu.observability.perf import (
+                    CostModel,
+                )
+
+                cm = CostModel(device=jax.devices()[0])
+                x = jnp.ones((batch_limit, n_in), jnp.float32)
+                entry = cm.register_jit_entry(
+                    cache, "predict", net.params, net.states, x)
+                if entry is not None:
+                    rows_per_sec = (best["requests_per_sec"]
+                                    * (sum(row_sizes) / len(row_sizes)))
+                    flops_per_row = entry["flops"] / batch_limit
+                    best["mfu_cost_model"] = round(
+                        flops_per_row * rows_per_sec / cm.peak_flops, 6)
+                    best["predict_flops_per_row"] = round(
+                        flops_per_row, 1)
+                    best["cost_source"] = entry["source"]
+            except Exception:   # noqa: BLE001 - introspection is optional
+                pass
         return best
     finally:
         pi.shutdown()

@@ -1,49 +1,107 @@
-"""JitCache: a jit-program cache that counts traces.
+"""JitCache: a jit-program cache that counts traces AND explains them.
 
 XLA compiles one program per (function, input signature); an unexpected
 shape reaching a cached `jax.jit` function silently triggers a retrace
 plus a full recompile — the compile-once concern the TPU-compilation
 literature identifies as make-or-break for serving latency. The cache
-itself is still a plain dict of jitted callables; the addition is a
-thread-safe trace counter incremented from *inside* each traced
-function body (a Python side effect in a traced function runs exactly
-once per trace), so "did this load cause a recompile?" becomes an
-asserted property instead of a profiling session:
+is still a plain dict of jitted callables with a thread-safe trace
+counter incremented from *inside* each traced function body (a Python
+side effect in a traced function runs exactly once per trace), so "did
+this load cause a recompile?" is an asserted property.
+
+Recompile FORENSICS (the "why did step 1042 take 8s" instrument):
+`__setitem__` wraps every stored callable in a thin timing shim. A call
+whose trace counter advanced included a trace+compile; the shim records
+a compile event — the concrete shape/dtype signature of the args that
+caused it, the call's wall duration (dominated by trace+compile on a
+compile call), a wall-clock timestamp, and the program's cost-model
+digest when one was registered (`register_cost`, fed by
+`observability.perf.CostModel.register_jit_entry`) — into a bounded
+ring surfaced on /status, and bumps `dl4j_jit_compiles_total`. Calls
+that hit the compiled cache pay two perf_counter reads and one int
+compare.
 
     cache = JitCache()
     def f(x):
         cache.record_trace("predict")
         return x * 2
     cache["predict"] = jax.jit(f)
-
-`trace_counts()` snapshots {key: traces}; serving surfaces it on
-/status and the warmup regression test pins it to zero new traces
-under a mixed-size load.
+    ...
+    cache.compile_events()   # [{key, signature, duration_s, ...}]
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Dict
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from deeplearning4j_tpu.observability import metrics as _obs
+
+COMPILE_RING = 16
+
+
+def _describe(a, depth: int = 0) -> str:
+    """Compact signature of one argument: arrays as dtype[shape],
+    containers abbreviated to their first few entries."""
+    shape = getattr(a, "shape", None)
+    dtype = getattr(a, "dtype", None)
+    if shape is not None and dtype is not None:
+        dims = ",".join(str(int(d)) for d in shape)
+        return f"{dtype}[{dims}]"
+    if depth >= 3:
+        return type(a).__name__
+    if isinstance(a, (list, tuple)):
+        head = ",".join(_describe(v, depth + 1) for v in a[:3])
+        tail = f",…+{len(a) - 3}" if len(a) > 3 else ""
+        return f"[{head}{tail}]"
+    if isinstance(a, dict):
+        head = ",".join(f"{k}:{_describe(v, depth + 1)}"
+                        for k, v in list(a.items())[:3])
+        tail = f",…+{len(a) - 3}" if len(a) > 3 else ""
+        return "{" + head + tail + "}"
+    if a is None:
+        return "None"
+    return type(a).__name__
+
+
+def describe_signature(args, kwargs=None) -> str:
+    parts = [_describe(a) for a in args]
+    for k, v in (kwargs or {}).items():
+        parts.append(f"{k}={_describe(v)}")
+    return "(" + ", ".join(parts) + ")"
 
 
 class JitCache(dict):
-    """Dict of jitted programs + per-key trace counters.
+    """Dict of jitted programs + per-key trace counters + a compile-
+    event forensics ring.
 
     Counters survive `clear()` of the program dict deliberately: a
     cleared cache that re-traces is exactly the recompile event the
     counters exist to expose."""
 
-    def __init__(self, *args, **kwargs):
-        super().__init__(*args, **kwargs)
+    def __init__(self, *args, compile_ring: int = COMPILE_RING,
+                 **kwargs):
+        super().__init__()
         self._trace_lock = threading.Lock()
         self._trace_counts: Dict[str, int] = {}
+        # lock-free fast-path read for the call shim (GIL-atomic int);
+        # writes stay under the lock
+        self._total = 0
+        self._compiles = 0
+        self._compile_events: deque = deque(
+            maxlen=max(1, int(compile_ring)))
+        self._costs: Dict[str, dict] = {}
+        for k, v in dict(*args, **kwargs).items():
+            self[k] = v
 
     def record_trace(self, key: str) -> None:
         """Call from inside a to-be-jitted function body: runs once per
         trace (= once per compiled specialization), never at runtime."""
         with self._trace_lock:
             self._trace_counts[key] = self._trace_counts.get(key, 0) + 1
+            self._total += 1
 
     def trace_counts(self) -> Dict[str, int]:
         with self._trace_lock:
@@ -52,3 +110,78 @@ class JitCache(dict):
     def total_traces(self) -> int:
         with self._trace_lock:
             return sum(self._trace_counts.values())
+
+    # ------------------------------------------------------- forensics
+    def __setitem__(self, key, fn):
+        if callable(fn) and not getattr(fn, "_jit_cache_shim", False):
+            fn = self._instrument(key, fn)
+        super().__setitem__(key, fn)
+
+    def _instrument(self, key, fn):
+        """Timing shim: a call during which the trace counter advanced
+        included a trace+compile — record the forensics event. The
+        no-compile fast path pays two clock reads and an int compare."""
+        def call(*args, **kwargs):
+            before = self._total
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            if self._total != before:
+                self._note_compile(key, self._total - before,
+                                   time.perf_counter() - t0,
+                                   args, kwargs)
+            return out
+
+        call._jit_cache_shim = True
+        call.__wrapped__ = fn
+        return call
+
+    def _note_compile(self, key, traces: int, duration_s: float,
+                      args, kwargs) -> None:
+        try:
+            signature = describe_signature(args, kwargs)
+        except Exception:   # noqa: BLE001 - forensics is best-effort
+            signature = "<unavailable>"
+        event = {
+            "key": str(key),
+            "signature": signature,
+            "duration_s": round(duration_s, 6),
+            "traces": int(traces),
+            "wall_time": time.time(),
+            "cost_digest": self._cost_digest(key),
+        }
+        with self._trace_lock:
+            self._compiles += int(traces)
+            self._compile_events.append(event)
+        _obs.count("dl4j_jit_compiles_total", n=int(traces))
+
+    def _cost_digest(self, key) -> Optional[dict]:
+        cost = self._costs.get(str(key))
+        if cost is None:
+            return None
+        return {"flops": cost.get("flops"),
+                "bytes_accessed": cost.get("bytes_accessed")}
+
+    def register_cost(self, key, cost: dict) -> None:
+        """Attach a cost-model entry ({flops, bytes_accessed, ...}) to
+        `key`: future compile events for the key carry the digest, and
+        ring events already recorded without one are backfilled."""
+        with self._trace_lock:
+            self._costs[str(key)] = dict(cost)
+            digest = {"flops": cost.get("flops"),
+                      "bytes_accessed": cost.get("bytes_accessed")}
+            for ev in self._compile_events:
+                if ev["key"] == str(key) and ev["cost_digest"] is None:
+                    ev["cost_digest"] = dict(digest)
+
+    def costs(self) -> Dict[str, dict]:
+        with self._trace_lock:
+            return {k: dict(v) for k, v in self._costs.items()}
+
+    def compile_events(self) -> List[dict]:
+        """Snapshot of the recent-compiles ring, oldest first."""
+        with self._trace_lock:
+            return [dict(ev) for ev in self._compile_events]
+
+    def compiles_total(self) -> int:
+        with self._trace_lock:
+            return self._compiles

@@ -90,6 +90,13 @@ REGISTERED_METRICS = frozenset({
     "dl4j_serving_active_models",
     "dl4j_serving_replica_failovers_total",
     "dl4j_jit_traces_total",
+    "dl4j_jit_compiles_total",
+    # performance introspection (observability/perf.py)
+    "dl4j_perf_mfu",
+    "dl4j_perf_program_flops",
+    "dl4j_perf_program_bytes",
+    "dl4j_perf_arithmetic_intensity",
+    "dl4j_train_phase_seconds",
     # resilience plumbing
     "dl4j_retry_attempts_total",
     "dl4j_breaker_transitions_total",
@@ -158,7 +165,9 @@ class MetricsRegistry:
         self._counters: Dict[str, Dict[_LabelKey, float]] = {}
         self._gauges: Dict[str, Dict[_LabelKey, float]] = {}
         self._gauge_fns: Dict[str, Callable[[], float]] = {}
-        self._hists: Dict[str, _Hist] = {}
+        # histograms are label-aware (dl4j_train_phase_seconds{phase=})
+        # — one _Hist per (name, label set), unlabeled = the () key
+        self._hists: Dict[str, Dict[_LabelKey, _Hist]] = {}
         self._created = time.monotonic()
         self.dropped = 0
 
@@ -182,14 +191,22 @@ class MetricsRegistry:
         with self._lock:
             self._gauge_fns[name] = fn
 
-    def observe(self, name: str, value: float, buckets=None) -> None:
+    def _hist(self, name: str, key: _LabelKey, buckets) -> _Hist:
+        """The (name, label set) histogram, created on first observe.
+        Caller holds the lock."""
+        series = self._hists.setdefault(name, {})
+        h = series.get(key)
+        if h is None:
+            h = _Hist(buckets if buckets is not None
+                      else DEFAULT_BUCKETS, self._ring_size)
+            series[key] = h
+        return h
+
+    def observe(self, name: str, value: float, buckets=None,
+                labels: Optional[dict] = None) -> None:
+        key = _label_key(labels)
         with self._lock:
-            h = self._hists.get(name)
-            if h is None:
-                h = _Hist(buckets if buckets is not None
-                          else DEFAULT_BUCKETS, self._ring_size)
-                self._hists[name] = h
-            h.observe(float(value))
+            self._hist(name, key, buckets).observe(float(value))
 
     def inc_observe(self, counter_name: str, hist_name: str,
                     value: float, n: float = 1.0,
@@ -201,29 +218,23 @@ class MetricsRegistry:
         with self._lock:
             series = self._counters.setdefault(counter_name, {})
             series[()] = series.get((), 0.0) + n
-            h = self._hists.get(hist_name)
-            if h is None:
-                h = _Hist(buckets if buckets is not None
-                          else DEFAULT_BUCKETS, self._ring_size)
-                self._hists[hist_name] = h
-            h.observe(float(value))
+            self._hist(hist_name, (), buckets).observe(float(value))
 
     def apply_batch(self, counts: Dict[str, float],
-                    hist_values: Dict[str, List[float]],
-                    buckets=None) -> None:
+                    hist_values: Dict, buckets=None) -> None:
         """Atomically fold in a StepAccumulator's pending aggregate —
         totals and observations identical to emitting one by one, for
-        one lock acquisition per flush instead of per step."""
+        one lock acquisition per flush instead of per step. Histogram
+        keys are either a name or a (name, label-key) tuple (the
+        accumulator's labeled-observation form)."""
         with self._lock:
             for name, n in counts.items():
                 series = self._counters.setdefault(name, {})
                 series[()] = series.get((), 0.0) + n
-            for name, vals in hist_values.items():
-                h = self._hists.get(name)
-                if h is None:
-                    h = _Hist(buckets if buckets is not None
-                              else DEFAULT_BUCKETS, self._ring_size)
-                    self._hists[name] = h
+            for hkey, vals in hist_values.items():
+                name, lk = (hkey if isinstance(hkey, tuple)
+                            else (hkey, ()))
+                h = self._hist(name, lk, buckets)
                 for v in vals:
                     h.observe(v)
 
@@ -290,17 +301,20 @@ class MetricsRegistry:
                 name: {_label_str(k): v for k, v in series.items()}
                 for name, series in self._gauges.items()}
             hists = {}
-            for name, h in self._hists.items():
-                hists[name] = {
-                    "count": h.count,
-                    "sum": round(h.sum, 9),
-                    "buckets": {("+Inf" if i == len(h.buckets)
-                                 else repr(h.buckets[i])): c
-                                for i, c in enumerate(h.counts)},
-                    "p50": h.quantile(0.50),
-                    "p90": h.quantile(0.90),
-                    "p99": h.quantile(0.99),
-                }
+            for name, series in self._hists.items():
+                for lk, h in series.items():
+                    # unlabeled series keeps the bare name (the
+                    # pre-labeled-histogram snapshot contract)
+                    hists[name + _label_str(lk)] = {
+                        "count": h.count,
+                        "sum": round(h.sum, 9),
+                        "buckets": {("+Inf" if i == len(h.buckets)
+                                     else repr(h.buckets[i])): c
+                                    for i, c in enumerate(h.counts)},
+                        "p50": h.quantile(0.50),
+                        "p90": h.quantile(0.90),
+                        "p99": h.quantile(0.99),
+                    }
             dropped = self.dropped
         for name, v in pulled.items():
             gauges.setdefault(name, {})[""] = v
@@ -312,32 +326,56 @@ class MetricsRegistry:
     def prometheus_text(self) -> str:
         """Prometheus text exposition format 0.0.4 (the GET /metrics
         body)."""
-        snap = self.snapshot()
-        lines: List[str] = []
-        for name in sorted(snap["counters"]):
-            lines.append(f"# TYPE {name} counter")
-            for lab, v in sorted(snap["counters"][name].items()):
-                lines.append(f"{name}{lab} {_fmt(v)}")
-        for name in sorted(snap["gauges"]):
-            lines.append(f"# TYPE {name} gauge")
-            for lab, v in sorted(snap["gauges"][name].items()):
-                lines.append(f"{name}{lab} {_fmt(v)}")
-        for name in sorted(snap["histograms"]):
-            h = snap["histograms"][name]
-            lines.append(f"# TYPE {name} histogram")
-            cum = 0
-            for le, c in h["buckets"].items():
-                cum += c
-                lines.append(f'{name}_bucket{{le="{le}"}} {cum}')
-            lines.append(f"{name}_sum {_fmt(h['sum'])}")
-            lines.append(f"{name}_count {h['count']}")
-        return "\n".join(lines) + "\n"
+        return render_prometheus(self.snapshot())
 
 
 def _fmt(v: float) -> str:
     if v == int(v) and abs(v) < 1e15:
         return str(int(v))
     return repr(v)
+
+
+def _split_hist_name(full: str) -> Tuple[str, str]:
+    """'name{a="b"}' -> ('name', 'a="b"'); bare names -> (name, '')."""
+    base, _, lab = full.partition("{")
+    return base, (lab[:-1] if lab.endswith("}") else lab)
+
+
+def _bucket_order(item) -> float:
+    le = item[0]
+    return float("inf") if le == "+Inf" else float(le)
+
+
+def render_prometheus(snap: dict) -> str:
+    """Render a `MetricsRegistry.snapshot()`-shaped dict to Prometheus
+    text exposition 0.0.4. Module-level so perf.aggregate_snapshots can
+    render a merged fleet-level snapshot through the exact same code
+    path as a single registry's /metrics body."""
+    lines: List[str] = []
+    for name in sorted(snap.get("counters", {})):
+        lines.append(f"# TYPE {name} counter")
+        for lab, v in sorted(snap["counters"][name].items()):
+            lines.append(f"{name}{lab} {_fmt(v)}")
+    for name in sorted(snap.get("gauges", {})):
+        lines.append(f"# TYPE {name} gauge")
+        for lab, v in sorted(snap["gauges"][name].items()):
+            lines.append(f"{name}{lab} {_fmt(v)}")
+    typed = set()
+    for full in sorted(snap.get("histograms", {})):
+        h = snap["histograms"][full]
+        base, inner = _split_hist_name(full)
+        if base not in typed:
+            typed.add(base)
+            lines.append(f"# TYPE {base} histogram")
+        pre = inner + "," if inner else ""
+        suffix = "{" + inner + "}" if inner else ""
+        cum = 0
+        for le, c in sorted(h["buckets"].items(), key=_bucket_order):
+            cum += c
+            lines.append(f'{base}_bucket{{{pre}le="{le}"}} {cum}')
+        lines.append(f"{base}_sum{suffix} {_fmt(h['sum'])}")
+        lines.append(f"{base}_count{suffix} {h['count']}")
+    return "\n".join(lines) + "\n"
 
 
 def parse_prometheus(text: str) -> Dict[str, float]:
@@ -409,12 +447,13 @@ def count(name: str, n: float = 1.0,
             pass
 
 
-def observe(name: str, value: float, buckets=None) -> None:
+def observe(name: str, value: float, buckets=None,
+            labels: Optional[dict] = None) -> None:
     if not _ENABLED:
         return
     try:
         _maybe_fire()
-        _DEFAULT.observe(name, value, buckets=buckets)
+        _DEFAULT.observe(name, value, buckets=buckets, labels=labels)
     except Exception:   # noqa: BLE001 - telemetry must never propagate
         try:
             _DEFAULT.note_dropped()
@@ -498,10 +537,23 @@ class StepAccumulator:
             return
         self._counts[name] = self._counts.get(name, 0.0) + n
 
-    def observe(self, name: str, value: float) -> None:
+    def observe(self, name: str, value: float,
+                labels: Optional[dict] = None) -> None:
+        """Labeled observations (the phase-attribution site) key the
+        pending dict on (name, label-key); apply_batch folds both forms
+        into the registry identically."""
         if not _ENABLED:
             return
-        self._hist_vals.setdefault(name, []).append(float(value))
+        key = (name, _label_key(labels)) if labels else name
+        self._hist_vals.setdefault(key, []).append(float(value))
+
+    def observe_keyed(self, key, value: float) -> None:
+        """Pre-resolved (name, label-key) observation — the phase
+        profiler's per-step fast path (no label dict built, no sort
+        per call; the key tuples are computed once at import)."""
+        if not _ENABLED:
+            return
+        self._hist_vals.setdefault(key, []).append(float(value))
 
     def count_observe(self, counter_name: str, hist_name: str,
                       value: float, n: float = 1.0) -> None:

@@ -1,0 +1,543 @@
+"""Performance introspection: cost-model MFU accounting, step phase
+attribution, cross-rank metric aggregation.
+
+ROADMAP item 2 ("profile the step, then attack") needs the repo to
+explain its own step time before anything cuts it. Four instruments,
+all riding the PR 5 telemetry substrate:
+
+  CostModel            per-compiled-program flops / bytes-accessed /
+                       peak-memory from XLA cost analysis
+                       (`lowered.compile().cost_analysis()`), with an
+                       analytic fallback for backends that return
+                       nothing. Yields exact MFU (measured step time x
+                       program flops / device peak), arithmetic
+                       intensity, and a roofline classification — the
+                       flops/bytes accounting the TPP (arXiv
+                       2104.05755) and weight-update-sharding (arXiv
+                       2004.13336) work both lean on to decide WHERE
+                       to optimize. `perf_report()` lands the numbers
+                       as registry gauges and a dict.
+  StepPhaseProfiler    decomposes every training step into named
+                       phases (data_wait / h2d / dispatch /
+                       device_compute / host_sync / checkpoint /
+                       telemetry) from perf_counter marks the fit
+                       loops already pay for; emits
+                       `dl4j_train_phase_seconds{phase=...}` through
+                       the owning loop's StepAccumulator so the
+                       overhead stays under the PR 5 <2% bar.
+  recompile forensics  lives in nn/jit_cache.py (signature + duration
+                       ring per new trace, `dl4j_jit_compiles_total`);
+                       `CostModel.register_jit_entry` attaches cost
+                       digests to the ring.
+  aggregate_snapshots  rank-0 pull path: merge per-rank
+                       MetricsRegistry snapshot dumps (written by
+                       `dump_snapshot`, e.g. from distributed_worker
+                       at exit) into ONE fleet-level snapshot —
+                       counters summed, histogram buckets merged,
+                       gauges re-keyed per rank — rendered through the
+                       same `render_prometheus` as a single /metrics
+                       body.
+
+Everything here is host-side bookkeeping: no jax import at module
+scope, so the aggregation path stays usable in no-jax drills
+(cluster supervisor, tier-1 tests).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+from deeplearning4j_tpu.observability import metrics as _obs
+from deeplearning4j_tpu.observability.metrics import render_prometheus
+
+# per-chip peak compute (bf16 unless the hardware has no bf16 units)
+# and HBM bandwidth — the two roofline axes. "cpu" entries are nominal
+# placeholders: MFU on CPU is a smoke-test number, not a claim.
+PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,    # v5e bf16
+    "TPU v4": 275e12,
+    "TPU v3": 123e12,
+    "cpu": 1e12,
+}
+PEAK_BYTES_PER_S = {
+    "TPU v5 lite": 819e9,
+    "TPU v4": 1228e9,
+    "TPU v3": 900e9,
+    "cpu": 50e9,
+}
+_DEFAULT_PEAK_FLOPS = 197e12
+_DEFAULT_PEAK_BW = 819e9
+
+
+def device_peaks(device=None) -> Tuple[float, float, str]:
+    """(peak_flops, peak_bytes_per_s, device_kind) for `device` (default
+    jax.devices()[0]); unknown kinds fall back to the v5e numbers."""
+    kind = "unknown"
+    try:
+        if device is None:
+            import jax
+
+            device = jax.devices()[0]
+        kind = str(device.device_kind)
+    except Exception:   # noqa: BLE001 - no backend: nominal peaks
+        pass
+    return (PEAK_FLOPS.get(kind, _DEFAULT_PEAK_FLOPS),
+            PEAK_BYTES_PER_S.get(kind, _DEFAULT_PEAK_BW), kind)
+
+
+# ------------------------------------------------ analytic flop counts
+def matmul_flops(m: int, k: int, n: int) -> float:
+    """[m,k] @ [k,n]: one multiply + one add per MAC."""
+    return 2.0 * m * k * n
+
+
+def conv2d_flops(batch: int, out_h: int, out_w: int, c_out: int,
+                 kh: int, kw: int, c_in: int) -> float:
+    """Direct convolution MACs x2 (XLA's accounting for VALID padding;
+    SAME padding does fewer real MACs at the edges, which XLA also
+    counts exactly — use this only as the fallback/cross-check)."""
+    return 2.0 * batch * out_h * out_w * c_out * kh * kw * c_in
+
+
+def train_step_flops_from_params(n_params: int, rows: int) -> float:
+    """The classic 6NB estimate (2NB forward + 4NB backward) for a
+    dense model with N params on a B-row batch — the coarse analytic
+    fallback when XLA reports nothing and no exact count is known."""
+    return 6.0 * float(n_params) * float(rows)
+
+
+# ------------------------------------------------- XLA cost extraction
+def _normalize_cost(ca) -> Optional[dict]:
+    """`cost_analysis()` returns a dict on some backends and a list of
+    per-computation dicts on others; fold either into
+    {flops, bytes_accessed} or None when nothing usable came back."""
+    if ca is None:
+        return None
+    entries = ca if isinstance(ca, (list, tuple)) else [ca]
+    flops = 0.0
+    bytes_accessed = 0.0
+    for e in entries:
+        if not isinstance(e, dict):
+            continue
+        flops += float(e.get("flops", 0.0) or 0.0)
+        bytes_accessed += float(e.get("bytes accessed", 0.0) or 0.0)
+    if flops <= 0.0:
+        return None
+    return {"flops": flops, "bytes_accessed": bytes_accessed}
+
+
+def extract_cost(target, *args, **kwargs) -> Optional[dict]:
+    """Pull {flops, bytes_accessed, peak_bytes} from XLA cost analysis.
+
+    `target` is either a `jax.jit`-wrapped callable — lowered and
+    compiled here with the given example (or ShapeDtypeStruct) args —
+    or an already-compiled jax.stages object (the AOT path benches use
+    to avoid a duplicate compile). Returns None when the backend
+    reports nothing usable (the analytic-fallback trigger)."""
+    try:
+        compiled = target
+        if not hasattr(compiled, "cost_analysis"):
+            compiled = target.lower(*args, **kwargs).compile()
+        entry = _normalize_cost(compiled.cost_analysis())
+        if entry is None:
+            return None
+        try:
+            mem = compiled.memory_analysis()
+            entry["peak_bytes"] = int(
+                getattr(mem, "temp_size_in_bytes", 0)
+                + getattr(mem, "argument_size_in_bytes", 0)
+                + getattr(mem, "output_size_in_bytes", 0))
+        except Exception:   # noqa: BLE001 - memory stats are optional
+            entry["peak_bytes"] = None
+        return entry
+    except Exception:   # noqa: BLE001 - cost extraction must never raise
+        return None
+
+
+class CostModel:
+    """Per-program flops/bytes registry + MFU / roofline arithmetic.
+
+    Register each compiled program once (outside the timed region),
+    then `perf_report(key, seconds_per_call=...)` turns a measured
+    step time into MFU, arithmetic intensity, and a roofline verdict —
+    and lands them as `dl4j_perf_*` registry gauges so the dashboard
+    and /metrics see the same numbers the bench JSON records."""
+
+    def __init__(self, peak_flops: Optional[float] = None,
+                 peak_bytes_per_s: Optional[float] = None,
+                 device=None):
+        det_flops, det_bw, kind = device_peaks(device)
+        self.peak_flops = float(peak_flops or det_flops)
+        self.peak_bytes_per_s = float(peak_bytes_per_s or det_bw)
+        self.device_kind = kind
+        self._entries: Dict[str, dict] = {}
+
+    # ------------------------------------------------------- register
+    def register_compiled(self, key, target, *args,
+                          analytic_flops: Optional[float] = None,
+                          analytic_bytes: Optional[float] = None,
+                          **kwargs) -> dict:
+        """XLA cost analysis first; `analytic_*` are the fallback for
+        backends whose cost analysis returns nothing. Raises ValueError
+        only when BOTH sources are empty."""
+        entry = extract_cost(target, *args, **kwargs)
+        if entry is not None:
+            entry["source"] = "xla_cost_analysis"
+        elif analytic_flops:
+            entry = {"flops": float(analytic_flops),
+                     "bytes_accessed": float(analytic_bytes or 0.0),
+                     "peak_bytes": None, "source": "analytic"}
+        else:
+            raise ValueError(
+                f"no cost available for {key!r}: XLA cost analysis "
+                "returned nothing and no analytic fallback was given")
+        self._entries[str(key)] = entry
+        return dict(entry)
+
+    def register_analytic(self, key, flops: float,
+                          bytes_accessed: float = 0.0) -> dict:
+        entry = {"flops": float(flops),
+                 "bytes_accessed": float(bytes_accessed),
+                 "peak_bytes": None, "source": "analytic"}
+        self._entries[str(key)] = entry
+        return dict(entry)
+
+    def register_jit_entry(self, cache, key, *args,
+                           analytic_flops: Optional[float] = None,
+                           analytic_bytes: Optional[float] = None,
+                           **kwargs) -> Optional[dict]:
+        """Cost for a JitCache entry: unwraps the cache's forensics
+        wrapper, extracts/falls back, and hands the digest back to the
+        cache so its recompile ring carries it. Returns None (instead
+        of raising) when no cost is available — serving warmup calls
+        this opportunistically."""
+        fn = cache.get(key)
+        if fn is None:
+            return None
+        fn = getattr(fn, "__wrapped__", fn)
+        try:
+            entry = self.register_compiled(
+                key, fn, *args, analytic_flops=analytic_flops,
+                analytic_bytes=analytic_bytes, **kwargs)
+        except ValueError:
+            return None
+        if hasattr(cache, "register_cost"):
+            cache.register_cost(key, entry)
+        return entry
+
+    # ----------------------------------------------------------- reads
+    def entry(self, key) -> Optional[dict]:
+        e = self._entries.get(str(key))
+        return dict(e) if e is not None else None
+
+    def keys(self) -> List[str]:
+        return list(self._entries)
+
+    def arithmetic_intensity(self, key) -> Optional[float]:
+        e = self._entries.get(str(key))
+        if e is None or not e.get("bytes_accessed"):
+            return None
+        return e["flops"] / e["bytes_accessed"]
+
+    def mfu(self, key, seconds_per_call: float) -> Optional[float]:
+        """Model flops utilization: program flops / wall seconds /
+        device peak. The honest headline — counts the flops the model
+        NEEDS (as compiled), not the flops the kernel burned."""
+        e = self._entries.get(str(key))
+        if e is None or seconds_per_call <= 0.0:
+            return None
+        return e["flops"] / seconds_per_call / self.peak_flops
+
+    def roofline(self, key) -> Optional[dict]:
+        """Where this program sits on the roofline: arithmetic
+        intensity vs the ridge point (peak_flops / peak_bw), plus the
+        bandwidth-bound attainable flops ceiling."""
+        ai = self.arithmetic_intensity(key)
+        if ai is None:
+            return None
+        ridge = self.peak_flops / self.peak_bytes_per_s
+        return {
+            "arithmetic_intensity": ai,
+            "ridge_point": ridge,
+            "bound": "compute" if ai >= ridge else "memory",
+            "attainable_flops_per_s": min(
+                self.peak_flops, ai * self.peak_bytes_per_s),
+        }
+
+    def perf_report(self, key, seconds_per_call: Optional[float] = None,
+                    items_per_call: Optional[float] = None) -> dict:
+        """One dict with everything ROADMAP item 2 needs to cite:
+        flops, bytes, arithmetic intensity, roofline verdict, and (when
+        a measured `seconds_per_call` is given) MFU + achieved
+        flops/s. Also lands the numbers as `dl4j_perf_*` gauges."""
+        e = self._entries.get(str(key))
+        if e is None:
+            raise KeyError(f"no cost registered for {key!r}")
+        report = {
+            "program": str(key),
+            "source": e["source"],
+            "flops": e["flops"],
+            "bytes_accessed": e["bytes_accessed"],
+            "peak_bytes": e.get("peak_bytes"),
+            "device_kind": self.device_kind,
+            "peak_flops": self.peak_flops,
+            "peak_bytes_per_s": self.peak_bytes_per_s,
+        }
+        roof = self.roofline(key)
+        if roof is not None:
+            report.update(roof)
+        if items_per_call:
+            report["flops_per_item"] = e["flops"] / items_per_call
+        if seconds_per_call:
+            report["seconds_per_call"] = seconds_per_call
+            report["achieved_flops_per_s"] = \
+                e["flops"] / seconds_per_call
+            report["mfu"] = self.mfu(key, seconds_per_call)
+        labels = {"program": str(key)}
+        _obs.set_gauge("dl4j_perf_program_flops", e["flops"],
+                       labels=labels)
+        _obs.set_gauge("dl4j_perf_program_bytes", e["bytes_accessed"],
+                       labels=labels)
+        if roof is not None:
+            _obs.set_gauge("dl4j_perf_arithmetic_intensity",
+                           roof["arithmetic_intensity"], labels=labels)
+        if report.get("mfu") is not None:
+            _obs.set_gauge("dl4j_perf_mfu", report["mfu"],
+                           labels=labels)
+        return report
+
+    def digest(self, key) -> Optional[dict]:
+        """Compact {flops, bytes, ai} for the JitCache forensics ring."""
+        e = self._entries.get(str(key))
+        if e is None:
+            return None
+        ai = self.arithmetic_intensity(key)
+        return {"flops": e["flops"],
+                "bytes_accessed": e["bytes_accessed"],
+                "arithmetic_intensity":
+                    round(ai, 3) if ai is not None else None}
+
+
+# ------------------------------------------------ step phase profiler
+PHASES = ("data_wait", "h2d", "dispatch", "device_compute",
+          "host_sync", "checkpoint", "telemetry")
+# pre-resolved accumulator keys: the per-step emission fast path pays
+# a dict lookup per phase, not a label-dict build + sort per phase
+_PHASE_KEYS = {p: ("dl4j_train_phase_seconds", (("phase", p),))
+               for p in PHASES}
+
+
+class StepPhaseProfiler:
+    """Attribute every training step's wall time to named phases.
+
+    The owning fit loop calls `begin_step()` once per step, `mark(p)`
+    at each phase boundary (phase p runs from its mark to the next
+    mark), optionally `sync(device_value)` right after dispatch — when
+    this step samples a device sync (`sync_every`), the blocked
+    `block_until_ready` interval becomes the device_compute phase —
+    and `end_step()` in its finally. Durations land as
+    `dl4j_train_phase_seconds{phase=...}` through the loop's
+    StepAccumulator (container appends per step, one guarded registry
+    write per flush — the PR 5 <2% discipline), cumulative totals stay
+    on the instance for `report()`, and with a tracer attached each
+    phase records a span on the shared timeline.
+
+    NOT thread-safe — one owner loop per instance, like the
+    accumulator it feeds."""
+
+    def __init__(self, accumulator=None, tracer=None,
+                 sync_every: int = 1):
+        self.accumulator = accumulator
+        self.tracer = tracer
+        # sync_every=N blocks on the device value every Nth step (0 =
+        # never): device_compute becomes visible at 1/N the host-sync
+        # cost; un-synced steps leave device time inside dispatch.
+        self.sync_every = max(0, int(sync_every))
+        self.totals: Dict[str, float] = {p: 0.0 for p in PHASES}
+        self.wall_s = 0.0
+        self.steps = 0
+        self._marks: List[Tuple[str, float]] = []
+        self._t_begin: Optional[float] = None
+        self._step = None
+
+    def begin_step(self, step=None) -> None:
+        self._t_begin = time.perf_counter()
+        self._marks = []
+        self._step = step
+
+    def mark(self, phase: str) -> None:
+        """Phase `phase` starts now (and the previous phase ends)."""
+        self._marks.append((phase, time.perf_counter()))
+
+    def should_sync(self, step=None) -> bool:
+        if self.sync_every <= 0:
+            return False
+        s = self.steps if step is None else int(step)
+        return s % self.sync_every == 0
+
+    def sync(self, value, step=None) -> None:
+        """Sampled device sync: on sampling steps, block until `value`
+        is ready and attribute the blocked interval to device_compute.
+        Swallows everything — profiling must never fail a step."""
+        if value is None or not self.should_sync(step):
+            return
+        self.mark("device_compute")
+        try:
+            import jax
+
+            jax.block_until_ready(value)
+        except Exception:   # noqa: BLE001 - profiling is best-effort
+            pass
+
+    def end_step(self) -> None:
+        if self._t_begin is None:
+            return
+        t_end = time.perf_counter()
+        marks = self._marks
+        durs: Dict[str, float] = {}
+        for i, (ph, t) in enumerate(marks):
+            t_next = marks[i + 1][1] if i + 1 < len(marks) else t_end
+            durs[ph] = durs.get(ph, 0.0) + max(0.0, t_next - t)
+        acc = self.accumulator
+        tr = self.tracer
+        for ph, d in durs.items():
+            self.totals[ph] = self.totals.get(ph, 0.0) + d
+            key = _PHASE_KEYS.get(ph)
+            if acc is not None and key is not None:
+                acc.observe_keyed(key, d)
+            else:
+                _obs.observe("dl4j_train_phase_seconds", d,
+                             labels={"phase": ph})
+        if tr is not None:
+            for i, (ph, t) in enumerate(marks):
+                t_next = marks[i + 1][1] if i + 1 < len(marks) else t_end
+                tr.record(f"phase:{ph}", t, t_next, cat="phase",
+                          args={"step": self._step})
+        # the profiler's own emission cost is telemetry time too —
+        # attribute it so coverage stays honest, not flattering
+        t_done = time.perf_counter()
+        self.totals["telemetry"] += t_done - t_end
+        self.wall_s += t_done - self._t_begin
+        self.steps += 1
+        self._t_begin = None
+        self._marks = []
+
+    def report(self) -> dict:
+        """Cumulative per-phase seconds + shares and the coverage
+        fraction (sum of attributed phase time / wall time of the
+        profiled steps) — the ≥95% acceptance observable."""
+        attributed = sum(self.totals.values())
+        phases = {
+            p: {"seconds": round(s, 6),
+                "share": (s / attributed) if attributed else 0.0}
+            for p, s in self.totals.items() if s > 0.0}
+        return {
+            "steps": self.steps,
+            "wall_s": round(self.wall_s, 6),
+            "attributed_s": round(attributed, 6),
+            "coverage": (attributed / self.wall_s) if self.wall_s
+            else 0.0,
+            "phases": phases,
+        }
+
+    def top_phases(self, n: int = 2) -> List[Tuple[str, float]]:
+        """The n largest phases by share — the dashboard line's view."""
+        attributed = sum(self.totals.values())
+        if attributed <= 0.0:
+            return []
+        ranked = sorted(self.totals.items(), key=lambda kv: -kv[1])
+        return [(p, s / attributed) for p, s in ranked[:n] if s > 0.0]
+
+
+# --------------------------------------------- cross-rank aggregation
+def dump_snapshot(path: str, registry=None, rank: Optional[int] = None,
+                  extra: Optional[dict] = None) -> str:
+    """Write this process's MetricsRegistry snapshot to `path` (tmp +
+    os.replace so a reader never sees a torn file) — the per-rank half
+    of the rank-0 pull path. `distributed_worker` calls this at exit;
+    `aggregate_snapshots` merges the files."""
+    snap = (registry or _obs.get_registry()).snapshot()
+    doc = {"rank": rank, "wall_time": time.time(), "snapshot": snap}
+    if extra:
+        doc.update(extra)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def _load_snapshot(source, fallback_rank: int) -> Tuple[dict, int]:
+    if isinstance(source, str):
+        with open(source) as f:
+            source = json.load(f)
+    rank = fallback_rank
+    snap = source
+    if isinstance(source, dict) and "snapshot" in source:
+        if source.get("rank") is not None:
+            rank = int(source["rank"])
+        snap = source["snapshot"]
+    return snap, rank
+
+
+def _with_rank(label_str: str, rank: int) -> str:
+    inner = f'rank="{rank}"'
+    if not label_str:
+        return "{" + inner + "}"
+    return label_str[:-1] + "," + inner + "}"
+
+
+def aggregate_snapshots(sources) -> dict:
+    """Merge per-rank snapshot dumps (paths, dump_snapshot docs, or raw
+    snapshot dicts) into ONE fleet-level snapshot: counters summed per
+    (name, label set), histogram buckets/counts/sums merged (ring
+    quantiles cannot merge exactly and are dropped), gauges re-keyed
+    with a rank label so per-rank values stay distinguishable. The
+    result renders through `render_prometheus` — the fleet /metrics
+    body MULTICHIP benches and the cluster supervisor report instead
+    of rank-local numbers."""
+    merged: dict = {"counters": {}, "gauges": {}, "histograms": {},
+                    "ranks": 0, "uptime_s": 0.0}
+    for i, source in enumerate(sources):
+        snap, rank = _load_snapshot(source, i)
+        for name, series in snap.get("counters", {}).items():
+            tgt = merged["counters"].setdefault(name, {})
+            for lab, v in series.items():
+                tgt[lab] = tgt.get(lab, 0.0) + float(v)
+        for name, series in snap.get("gauges", {}).items():
+            tgt = merged["gauges"].setdefault(name, {})
+            for lab, v in series.items():
+                tgt[_with_rank(lab, rank)] = float(v)
+        for name, h in snap.get("histograms", {}).items():
+            tgt = merged["histograms"].setdefault(
+                name, {"count": 0, "sum": 0.0, "buckets": {},
+                       "p50": None, "p90": None, "p99": None})
+            tgt["count"] += int(h.get("count", 0))
+            tgt["sum"] = round(tgt["sum"] + float(h.get("sum", 0.0)), 9)
+            for le, c in h.get("buckets", {}).items():
+                tgt["buckets"][le] = tgt["buckets"].get(le, 0) + int(c)
+        merged["ranks"] += 1
+        merged["uptime_s"] = max(merged["uptime_s"],
+                                 float(snap.get("uptime_s", 0.0)))
+    return merged
+
+
+def aggregate_prometheus_text(sources) -> str:
+    """One fleet-level Prometheus exposition from per-rank snapshot
+    files/dicts — `render_prometheus(aggregate_snapshots(...))`."""
+    return render_prometheus(aggregate_snapshots(sources))
+
+
+__all__ = [
+    "PEAK_FLOPS", "PEAK_BYTES_PER_S", "PHASES",
+    "CostModel", "StepPhaseProfiler",
+    "device_peaks", "extract_cost",
+    "matmul_flops", "conv2d_flops", "train_step_flops_from_params",
+    "dump_snapshot", "aggregate_snapshots", "aggregate_prometheus_text",
+    "render_prometheus",
+]

@@ -265,12 +265,18 @@ class ParallelInference:
 
     def trace_stats(self) -> dict:
         """The net's JitCache trace counters (empty for nets without
-        one) — the recompile-regression observable."""
+        one) — the recompile-regression observable — plus the compile-
+        event forensics ring (signature, duration, cost digest per new
+        trace) so /status can answer "what recompiled, and why"."""
         cache = getattr(self.net, "_jit_cache", None)
         if cache is None or not hasattr(cache, "trace_counts"):
             return {}
-        return {"trace_counts": cache.trace_counts(),
-                "total_traces": cache.total_traces()}
+        out = {"trace_counts": cache.trace_counts(),
+               "total_traces": cache.total_traces()}
+        if hasattr(cache, "compile_events"):
+            out["compiles_total"] = cache.compiles_total()
+            out["compile_events"] = cache.compile_events()
+        return out
 
     def stats(self) -> dict:
         """Pipeline + compile-guard facts (surfaced on /status)."""

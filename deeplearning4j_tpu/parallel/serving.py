@@ -315,6 +315,12 @@ class ModelServer:
             trace = pi.trace_stats()
             facts["trace_counts"] = trace.get("trace_counts", {})
             facts["total_traces"] = trace.get("total_traces", 0)
+            # recompile forensics: "why did that request take 8s" —
+            # the signature/duration/cost ring of recent new traces
+            facts["recompiles"] = {
+                "total": trace.get("compiles_total", 0),
+                "recent": trace.get("compile_events", []),
+            }
         if self.admission is not None:
             facts["admission"] = self.admission.stats()
         # telemetry facts (observability/): uptime + the registry's

@@ -80,7 +80,8 @@ class TrainingMaster:
                  skip_bad_batches: bool = False,
                  supervisor: Optional[Supervisor] = None,
                  guard_inner_steps: bool = False,
-                 tracer=None):
+                 tracer=None,
+                 phase_profiler=None):
         """`averaging_frequency=k > 1` runs k-step local SGD between
         parameter rendezvous — each dp shard trains privately for k
         steps, then params (+ updater state) are averaged. This is the
@@ -158,6 +159,22 @@ class TrainingMaster:
         self.tracer = tracer
         self._step_span = None
         self._obs_acc = _obs.StepAccumulator()
+        # step phase attribution (observability/perf.py): opt-in like
+        # the tracer; phase_profiler=True builds the default profiler.
+        # Emission rides THIS loop's StepAccumulator so the phase
+        # histograms cost container appends, not registry locks.
+        if phase_profiler is True:
+            from deeplearning4j_tpu.observability.perf import (
+                StepPhaseProfiler,
+            )
+
+            phase_profiler = StepPhaseProfiler()
+        self.phase_profiler = phase_profiler
+        if self.phase_profiler is not None:
+            if self.phase_profiler.accumulator is None:
+                self.phase_profiler.accumulator = self._obs_acc
+            if self.phase_profiler.tracer is None:
+                self.phase_profiler.tracer = tracer
 
     # ------------------------------------------------------------ dist init
     @staticmethod
@@ -303,6 +320,7 @@ class TrainingMaster:
             is_tbptt = getattr(net.conf, "backprop_type", None) \
                 == "truncated_bptt"
             tr = self.tracer
+            pp = self.phase_profiler
             with self.mesh:
                 step = start_step
                 while step < num_steps:
@@ -317,6 +335,8 @@ class TrainingMaster:
                     self._step_span = sp
                     if wd is not None:
                         wd.trace_parent = sp
+                    if pp is not None:
+                        pp.begin_step(step)
                     try:
                         step = self._fit_one_step(
                             batch_fn, step, is_graph, is_tbptt,
@@ -326,6 +346,8 @@ class TrainingMaster:
                             "dl4j_train_steps_total",
                             "dl4j_train_step_seconds",
                             time.perf_counter() - step_t0)
+                        if pp is not None:
+                            pp.end_step()
                         self._step_span = None
                         if sp is not None:
                             sp.end()
@@ -347,16 +369,21 @@ class TrainingMaster:
         guard = self.guard
         wd = self.watchdog
         tr = self.tracer
+        pp = self.phase_profiler
         sp = self._step_span
         _fire("train.step")
         _fire("train.hang")
         fire_hang_hard()
         if wd is not None:
             wd.beat("dispatch", step=step)
+        if pp is not None:
+            pp.mark("data_wait")
         t0 = time.perf_counter()
         batch = self._next_batch(batch_fn, step)
         if batch is None:       # bad batch skipped by policy
             return step + 1
+        if pp is not None:
+            pp.mark("h2d")
         x, y = self._global_batch(
             self._maybe_poison(batch[0]), batch[1])
         t1 = time.perf_counter()
@@ -376,6 +403,8 @@ class TrainingMaster:
                 if check_now and guard.policy == "skip_step"
                 else None)
         chunked = is_tbptt and getattr(x, "ndim", 0) == 3
+        if pp is not None:
+            pp.mark("dispatch")
         if is_graph:
             name = net.conf.network_inputs[0]
             if chunked:
@@ -391,6 +420,12 @@ class TrainingMaster:
             tr.record("dispatch", t1, t_disp, cat="train", parent=sp)
         if wd is not None:
             wd.beat("fetch", step=step)
+        if pp is not None:
+            # sampled device sync: the blocked interval on the step's
+            # loss value is the device_compute phase; everything after
+            # is host-side sync work (guard checks, score fetches)
+            pp.sync(getattr(net, "_score", None), step=step)
+            pp.mark("host_sync")
         if check_now:
             verdict = guard.post_step(net)
             if verdict != "ok":
@@ -416,10 +451,14 @@ class TrainingMaster:
             # span is the device+fetch-result phase made visible
             tr.record("device_sync", t_disp, t2, cat="train",
                       parent=sp)
+        if pp is not None:
+            pp.mark("telemetry")   # listener callbacks are user telemetry
         for listener in net.listeners:
             listener.iteration_done(net, net.iteration)
         t3 = time.perf_counter()
         if ckpt_due:
+            if pp is not None:
+                pp.mark("checkpoint")
             self.save_checkpoint(done)
         if collect_training_stats:
             self._stats.append({
@@ -541,6 +580,7 @@ class TrainingMaster:
                 per_step_losses=self.guard_inner_steps)
         is_graph = hasattr(net.conf, "network_inputs")
         every = self.checkpoint_every
+        pp = self.phase_profiler
         with self.mesh:
             step = start_step
             while step < num_steps:
@@ -550,6 +590,11 @@ class TrainingMaster:
                 fire_hang_hard()
                 if wd is not None:
                     wd.beat("dispatch", step=step)
+                # group-level phase attribution (guard-anomaly exits
+                # leave the group unprofiled; begin_step resets state)
+                if pp is not None:
+                    pp.begin_step(step)
+                    pp.mark("data_wait")
                 t0 = time.perf_counter()
                 span = min(step + k, num_steps) - step
                 group = []
@@ -564,6 +609,8 @@ class TrainingMaster:
                 if not group:
                     step += span
                     continue
+                if pp is not None:
+                    pp.mark("h2d")
                 xs = self._stage(np.stack([g[0] for g in group]),
                                  P(None, "dp"))
                 ys = self._stage(np.stack([g[1] for g in group]),
@@ -575,6 +622,8 @@ class TrainingMaster:
                 snap = (guard.snapshot(net)
                         if check_now and guard.policy == "skip_step"
                         else None)
+                if pp is not None:
+                    pp.mark("dispatch")
                 if is_graph:
                     name = net.conf.network_inputs[0]
                     self._local_step.run_arrays({name: xs}, [ys])
@@ -582,6 +631,8 @@ class TrainingMaster:
                     self._local_step.run_arrays(xs, ys)
                 if wd is not None:
                     wd.beat("fetch", step=step)
+                if pp is not None:
+                    pp.mark("host_sync")
                 if check_now and self.guard_inner_steps:
                     # granularity fix: the compiled group program also
                     # returned per-inner-step (dp-averaged) losses — a
@@ -660,7 +711,11 @@ class TrainingMaster:
                 # (group ends rarely align with checkpoint_every)
                 if (self.checkpoint_dir and every
                         and prev // every != step // every):
+                    if pp is not None:
+                        pp.mark("checkpoint")
                     self.save_checkpoint(step)
+                if pp is not None:
+                    pp.end_step()
                 if collect_training_stats:
                     self._stats.append({
                         "step": prev,
@@ -683,15 +738,19 @@ class TrainingMaster:
                 if self._local_step is not None else None)
         resil = self.resilience_stats()
         prof = self._profiler_stats()
+        phases = (self.phase_profiler.report()
+                  if self.phase_profiler is not None else None)
         if not stats:
             return {"steps": [], "summary": {}, "wire": wire,
-                    "resilience": resil, "profiler": prof}
+                    "resilience": resil, "profiler": prof,
+                    "phases": phases}
         summary = {
             k: float(np.mean([s[k] for s in stats]))
             for k in ("data_ms", "fit_ms", "listener_ms", "checkpoint_ms")
         }
         return {"steps": stats, "summary": summary, "wire": wire,
-                "resilience": resil, "profiler": prof}
+                "resilience": resil, "profiler": prof,
+                "phases": phases}
 
     def _profiler_stats(self):
         """Surface an attached ProfilerListener's device-trace facts

@@ -77,7 +77,8 @@ class ParallelWrapper:
                  averaging_frequency: int = 1, average_updaters: bool = True,
                  mesh: Optional[Mesh] = None, prefetch_buffer: int = 2,
                  threshold_compression: float = 0.0,
-                 guard=None, watchdog=None, snapshot_every: int = 0):
+                 guard=None, watchdog=None, snapshot_every: int = 0,
+                 phase_profiler=None):
         """`guard`/`watchdog` (resilience/supervisor.py) give fit() the
         same self-healing hooks as TrainingMaster: the NonFiniteGuard
         checks loss+params after (sampled) steps and skips or aborts on
@@ -123,6 +124,19 @@ class ParallelWrapper:
         # per-step telemetry batches through an accumulator (flushed
         # every 32 steps + at fit end) — appends, not registry locks
         self._obs_acc = _obs.StepAccumulator()
+        # step phase attribution (observability/perf.py): every step
+        # funnels through _run_guarded, so dispatch/host_sync phases
+        # land there; data_wait/h2d are not visible at this altitude
+        if phase_profiler is True:
+            from deeplearning4j_tpu.observability.perf import (
+                StepPhaseProfiler,
+            )
+
+            phase_profiler = StepPhaseProfiler()
+        self.phase_profiler = phase_profiler
+        if (self.phase_profiler is not None
+                and self.phase_profiler.accumulator is None):
+            self.phase_profiler.accumulator = self._obs_acc
 
     # ------------------------------------------------------------------
     def _ensure_sharded(self):
@@ -171,39 +185,51 @@ class ParallelWrapper:
         )
 
         g = self.guard
+        pp = self.phase_profiler
         check = g is not None and g.should_check(self._guard_steps)
         self._guard_steps += 1
         if self._snapshotter is not None:
             self._snapshotter.maybe_snapshot(self.net)
         snap = (g.snapshot(self.net)
                 if check and g.policy == "skip_step" else None)
-        t0 = time.perf_counter()
-        thunk()
-        # every ParallelWrapper step/group funnels through here: the
-        # one emission site covers single-step, local-SGD, and
-        # multi-io paths alike (batched; fit() flushes at loop end)
-        self._obs_acc.count_observe(
-            "dl4j_train_steps_total", "dl4j_train_step_seconds",
-            time.perf_counter() - t0)
-        if not check:
-            return True
-        verdict = g.post_step(self.net)
-        if verdict == "ok":
-            return True
-        if g.policy == "skip_step":
-            g.restore(self.net, snap)
-            g.note_skip()
-            return False
-        if g.policy == "rollback":
-            g.note_rollback()
-            if g.counters["rollbacks"] > g.max_rollbacks:
-                raise NonFiniteLossError(
-                    f"guard exceeded max_rollbacks={g.max_rollbacks} "
-                    f"(last verdict {verdict})")
-            self._snapshotter.restore(self.net)
-            return False
-        raise NonFiniteLossError(
-            f"{verdict} training state detected (policy=abort)")
+        if pp is not None:
+            pp.begin_step(self._guard_steps - 1)
+            pp.mark("dispatch")
+        try:
+            t0 = time.perf_counter()
+            thunk()
+            if pp is not None:
+                pp.sync(getattr(self.net, "_score", None),
+                        step=self._guard_steps - 1)
+                pp.mark("host_sync")
+            # every ParallelWrapper step/group funnels through here: the
+            # one emission site covers single-step, local-SGD, and
+            # multi-io paths alike (batched; fit() flushes at loop end)
+            self._obs_acc.count_observe(
+                "dl4j_train_steps_total", "dl4j_train_step_seconds",
+                time.perf_counter() - t0)
+            if not check:
+                return True
+            verdict = g.post_step(self.net)
+            if verdict == "ok":
+                return True
+            if g.policy == "skip_step":
+                g.restore(self.net, snap)
+                g.note_skip()
+                return False
+            if g.policy == "rollback":
+                g.note_rollback()
+                if g.counters["rollbacks"] > g.max_rollbacks:
+                    raise NonFiniteLossError(
+                        f"guard exceeded max_rollbacks={g.max_rollbacks} "
+                        f"(last verdict {verdict})")
+                self._snapshotter.restore(self.net)
+                return False
+            raise NonFiniteLossError(
+                f"{verdict} training state detected (policy=abort)")
+        finally:
+            if pp is not None:
+                pp.end_step()
 
     # ------------------------------------------------------------------
     def fit(self, data, epochs: int = 1):

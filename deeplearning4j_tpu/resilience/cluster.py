@@ -518,7 +518,7 @@ class ClusterSupervisor:
             raise
 
     def stats(self) -> dict:
-        return {
+        out = {
             "nprocs": self.nprocs,
             "generations": self.generation,
             "gang_restarts": self.gang_restarts,
@@ -529,3 +529,32 @@ class ClusterSupervisor:
             "resume_steps": list(self.resume_steps),
             "ledger": [dict(e) for e in self.restart_ledger],
         }
+        fleet = self.fleet_metrics()
+        if fleet is not None:
+            out["fleet_metric_ranks"] = fleet["ranks"]
+        return out
+
+    def fleet_metrics(self,
+                      metrics_dir: Optional[str] = None
+                      ) -> Optional[dict]:
+        """Merge the per-rank MetricsRegistry snapshot dumps the
+        workers write at exit (`metrics-rank<N>.json`, see
+        observability.perf.dump_snapshot) into ONE fleet-level view:
+        summed counters, merged histograms, per-rank gauges, and a
+        single Prometheus exposition — the supervisor reports
+        fleet-level throughput/MFU, not rank-local numbers. Returns
+        None when no rank has dumped yet."""
+        import glob as _glob
+
+        from deeplearning4j_tpu.observability import perf as _perf
+
+        d = metrics_dir or self.heartbeat_dir
+        paths = sorted(_glob.glob(
+            os.path.join(d, "metrics-rank*.json")))
+        if not paths:
+            return None
+        merged = _perf.aggregate_snapshots(paths)
+        return {"ranks": merged["ranks"],
+                "files": paths,
+                "snapshot": merged,
+                "prometheus": _perf.render_prometheus(merged)}

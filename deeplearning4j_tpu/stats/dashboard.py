@@ -384,6 +384,27 @@ def telemetry_lines(snapshot) -> list:
         if occ and occ.get("p50") is not None:
             serv.append(f"occupancy p50 {occ['p50']:g}")
         lines.append("serving — " + " · ".join(serv))
+    # performance introspection (observability/perf.py): cost-model
+    # MFU gauge, top phases by attributed share, recompile count
+    perf = []
+    mfu = gauge("dl4j_perf_mfu")
+    if mfu is not None:
+        perf.append(f"MFU {mfu:.3f}")
+    phase_prefix = "dl4j_train_phase_seconds{phase="
+    shares = {}
+    for key, h in hists.items():
+        if key.startswith(phase_prefix):
+            phase = key[len(phase_prefix):].strip('"}')
+            shares[phase] = shares.get(phase, 0.0) + float(h["sum"])
+    total = sum(shares.values())
+    if total > 0:
+        top = sorted(shares.items(), key=lambda kv: -kv[1])[:2]
+        perf.append("phases " + ", ".join(
+            f"{p} {s / total:.0%}" for p, s in top))
+    if "dl4j_jit_compiles_total" in c:
+        perf.append(f"{c['dl4j_jit_compiles_total']} recompiles")
+    if perf:
+        lines.append("perf — " + " · ".join(perf))
     return lines
 
 

@@ -162,6 +162,20 @@ def main():
     else:
         tm.fit(batch_fn, steps)
 
+    # per-rank metrics dump: the rank-0 pull path's input (cluster
+    # supervisor fleet_metrics / observability.perf.aggregate_snapshots
+    # merge these into one fleet-level exposition). Best-effort — a
+    # failed dump must not fail the drill.
+    try:
+        from deeplearning4j_tpu.observability.perf import dump_snapshot
+
+        dump_snapshot(
+            os.path.join(args.heartbeat_dir or args.out_dir,
+                         f"metrics-rank{args.pid}.json"),
+            rank=args.pid)
+    except Exception:   # noqa: BLE001
+        pass
+
     if args.stop_after:
         # simulated kill: exit without finishing; checkpoints remain
         print(f"pid={args.pid} stopped-after {args.stop_after}",

@@ -242,7 +242,8 @@ def test_metric_registry_matches_emission_sites_and_tests():
     # registered-name prefix (dashboard startswith filters); literals
     # in other dl4j_ namespaces (e.g. w2v kernel labels) are not metrics
     domains = re.compile(
-        r"dl4j_(train|serving|checkpoint|cluster|retry|breaker|jit|obs)_")
+        r"dl4j_(train|serving|checkpoint|cluster|retry|breaker|jit|obs"
+        r"|perf)_")
     unknown = {n for n in referenced
                if domains.match(n) and n not in REGISTERED_METRICS
                and not any(m.startswith(n) for m in REGISTERED_METRICS)}
@@ -280,6 +281,13 @@ def test_registered_metrics_cover_required_names():
         "dl4j_retry_attempts_total", "dl4j_breaker_transitions_total",
         "dl4j_cluster_gang_restarts_total",
         "dl4j_cluster_quarantined_workers_total",
+        # performance introspection (observability/perf.py)
+        "dl4j_jit_compiles_total",
+        "dl4j_perf_mfu",
+        "dl4j_perf_program_flops",
+        "dl4j_perf_program_bytes",
+        "dl4j_perf_arithmetic_intensity",
+        "dl4j_train_phase_seconds",
     } <= set(REGISTERED_METRICS)
 
 

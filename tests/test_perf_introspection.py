@@ -1,0 +1,562 @@
+"""Performance introspection tests (PR 7 tentpole): CostModel flops
+within 1% of the analytic count (matmul + conv), perf_report fields +
+registry gauges, analytic fallback, StepPhaseProfiler ≥95% wall-time
+attribution on the CPU smoke config, labeled phase histograms through
+the StepAccumulator, JitCache recompile forensics (shape-shifted trace
+ring, cost digests, /status surface), cross-rank `aggregate_snapshots`
+exactness (no-jax drill: summed counters, merged histogram buckets,
+one fleet Prometheus exposition), the cluster supervisor's
+fleet_metrics pull path, the dashboard perf line, and the perf_gate
+tool's verdict/exit-code contract."""
+
+import importlib.util
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.observability import (
+    MetricsRegistry,
+    StepAccumulator,
+    get_registry,
+)
+from deeplearning4j_tpu.observability import perf as perf_mod
+from deeplearning4j_tpu.observability.perf import (
+    CostModel,
+    StepPhaseProfiler,
+    aggregate_prometheus_text,
+    aggregate_snapshots,
+    conv2d_flops,
+    dump_snapshot,
+    extract_cost,
+    matmul_flops,
+)
+
+pytestmark = pytest.mark.obs
+
+N_IN, N_OUT, ROWS = 4, 3, 16
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    get_registry().reset()
+    yield
+    get_registry().reset()
+
+
+def _net(seed=7):
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    conf = (NeuralNetConfiguration.Builder().seed(seed).updater("adam")
+            .learning_rate(1e-2).activation("tanh").weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=N_OUT, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(N_IN))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _batch(step):
+    rng = np.random.default_rng(500 + step)
+    x = rng.normal(size=(ROWS, N_IN)).astype(np.float32)
+    y = np.eye(N_OUT, dtype=np.float32)[rng.integers(0, N_OUT, ROWS)]
+    return x, y
+
+
+# ==================================================== cost model: XLA
+def test_cost_model_matmul_flops_within_1pct():
+    """Acceptance: XLA-counted flops of a known matmul within 1% of
+    the analytic 2*m*k*n."""
+    import jax
+    import jax.numpy as jnp
+
+    m, k, n = 32, 64, 16
+    f = jax.jit(lambda a, b: jnp.dot(a, b))
+    cm = CostModel()
+    entry = cm.register_compiled(
+        "mm", f, jnp.ones((m, k), jnp.float32),
+        jnp.ones((k, n), jnp.float32))
+    analytic = matmul_flops(m, k, n)
+    assert entry["source"] == "xla_cost_analysis"
+    assert abs(entry["flops"] - analytic) / analytic < 0.01
+    assert entry["bytes_accessed"] > 0
+
+
+def test_cost_model_conv_flops_within_1pct():
+    """Acceptance: XLA-counted flops of a known VALID conv within 1%
+    of the analytic direct-convolution count."""
+    import jax
+    import jax.numpy as jnp
+
+    batch, hw, c_in, c_out, kk = 2, 16, 8, 32, 3
+
+    def conv(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, (1, 1), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    cm = CostModel()
+    entry = cm.register_compiled(
+        "conv", jax.jit(conv),
+        jnp.ones((batch, hw, hw, c_in), jnp.float32),
+        jnp.ones((kk, kk, c_in, c_out), jnp.float32))
+    out_hw = hw - kk + 1
+    analytic = conv2d_flops(batch, out_hw, out_hw, c_out, kk, kk, c_in)
+    assert entry["source"] == "xla_cost_analysis"
+    assert abs(entry["flops"] - analytic) / analytic < 0.01
+
+
+def test_cost_model_analytic_fallback_and_missing_cost():
+    """A backend returning no cost analysis falls back to the supplied
+    analytic count; with neither, registration refuses loudly."""
+    cm = CostModel(peak_flops=1e12, peak_bytes_per_s=1e11)
+    assert extract_cost(object()) is None
+    entry = cm.register_compiled("blind", object(),
+                                 analytic_flops=6e9, analytic_bytes=1e8)
+    assert entry["source"] == "analytic"
+    assert entry["flops"] == 6e9
+    assert cm.mfu("blind", seconds_per_call=0.01) \
+        == pytest.approx(6e9 / 0.01 / 1e12)
+    with pytest.raises(ValueError):
+        cm.register_compiled("nothing", object())
+
+
+def test_perf_report_fields_and_registry_gauges():
+    """perf_report carries flops/bytes/AI/roofline/MFU and lands the
+    dl4j_perf_* gauges in the global registry."""
+    import jax
+    import jax.numpy as jnp
+
+    cm = CostModel(peak_flops=1e12, peak_bytes_per_s=1e11)
+    cm.register_compiled("mm", jax.jit(lambda a, b: jnp.dot(a, b)),
+                         jnp.ones((64, 64)), jnp.ones((64, 64)))
+    report = cm.perf_report("mm", seconds_per_call=1e-3,
+                            items_per_call=64)
+    for field in ("flops", "bytes_accessed", "arithmetic_intensity",
+                  "ridge_point", "bound", "mfu",
+                  "achieved_flops_per_s", "flops_per_item"):
+        assert field in report, field
+    assert 0.0 < report["mfu"] <= 1.0
+    assert report["bound"] in ("compute", "memory")
+    r = get_registry()
+    labels = {"program": "mm"}
+    assert r.gauge_value("dl4j_perf_mfu", labels=labels) \
+        == pytest.approx(report["mfu"])
+    assert r.gauge_value("dl4j_perf_program_flops", labels=labels) \
+        == report["flops"]
+    assert r.gauge_value("dl4j_perf_program_bytes", labels=labels) \
+        == report["bytes_accessed"]
+    assert r.gauge_value("dl4j_perf_arithmetic_intensity",
+                         labels=labels) \
+        == pytest.approx(report["arithmetic_intensity"])
+    # roofline arithmetic: ridge = peak_flops / peak_bw
+    assert report["ridge_point"] == pytest.approx(10.0)
+
+
+# ============================================= labeled histograms
+def test_labeled_histograms_snapshot_and_exposition():
+    r = MetricsRegistry()
+    r.observe("dl4j_train_phase_seconds", 0.004,
+              labels={"phase": "dispatch"})
+    r.observe("dl4j_train_phase_seconds", 0.002,
+              labels={"phase": "data_wait"})
+    r.observe("dl4j_train_step_seconds", 0.01)   # unlabeled unchanged
+    snap = r.snapshot()
+    assert 'dl4j_train_phase_seconds{phase="dispatch"}' \
+        in snap["histograms"]
+    assert snap["histograms"]["dl4j_train_step_seconds"]["count"] == 1
+    text = r.prometheus_text()
+    assert ('dl4j_train_phase_seconds_bucket{phase="dispatch",'
+            'le="0.005"} 1') in text
+    assert 'dl4j_train_phase_seconds_sum{phase="dispatch"}' in text
+    assert 'dl4j_train_phase_seconds_count{phase="data_wait"} 1' in text
+    # unlabeled histogram exposition is byte-identical to the PR 5 form
+    assert 'dl4j_train_step_seconds_bucket{le="+Inf"} 1' in text
+
+
+def test_step_accumulator_labeled_observe_flush():
+    r = get_registry()
+    acc = StepAccumulator(flush_every=100)
+    for _ in range(3):
+        acc.observe("dl4j_train_phase_seconds", 0.001,
+                    labels={"phase": "dispatch"})
+    acc.observe("dl4j_train_phase_seconds", 0.002,
+                labels={"phase": "h2d"})
+    acc.flush()
+    snap = r.snapshot()
+    disp = snap["histograms"][
+        'dl4j_train_phase_seconds{phase="dispatch"}']
+    assert disp["count"] == 3
+    assert disp["sum"] == pytest.approx(0.003)
+    assert snap["histograms"][
+        'dl4j_train_phase_seconds{phase="h2d"}']["count"] == 1
+
+
+# ================================================ step phase profiler
+def test_phase_profiler_covers_wall_time_on_cpu_smoke():
+    """Acceptance: ≥95% of measured wall step time attributed to named
+    phases on the CPU smoke config (sampled device sync every step)."""
+    from deeplearning4j_tpu.parallel.training_master import (
+        TrainingMaster,
+    )
+
+    net = _net()
+    pp = StepPhaseProfiler(sync_every=1)
+    tm = TrainingMaster(net, phase_profiler=pp)
+    tm.fit(lambda s: _batch(s), 25)
+    rep = pp.report()
+    assert rep["steps"] == 25
+    assert rep["coverage"] >= 0.95, rep
+    assert set(rep["phases"]) <= set(perf_mod.PHASES)
+    # phase histograms landed (through the fit loop's accumulator)
+    snap = get_registry().snapshot()
+    disp = snap["histograms"][
+        'dl4j_train_phase_seconds{phase="dispatch"}']
+    assert disp["count"] == 25
+    # shares sum to 1 over attributed time
+    assert sum(p["share"] for p in rep["phases"].values()) \
+        == pytest.approx(1.0)
+    # the report also rides training_stats
+    assert tm.training_stats()["phases"]["steps"] == 25
+
+
+def test_phase_profiler_sync_sampling_and_checkpoint_phase(tmp_path):
+    from deeplearning4j_tpu.parallel.training_master import (
+        TrainingMaster,
+    )
+
+    net = _net()
+    pp = StepPhaseProfiler(sync_every=4)
+    tm = TrainingMaster(net, checkpoint_dir=str(tmp_path),
+                        checkpoint_every=2, phase_profiler=pp)
+    tm.fit(lambda s: _batch(s), 8)
+    rep = pp.report()
+    assert "checkpoint" in rep["phases"]   # 4 checkpoint steps
+    snap = get_registry().snapshot()
+    # device_compute observed only on the sampled (every-4th) steps
+    dc = snap["histograms"][
+        'dl4j_train_phase_seconds{phase="device_compute"}']
+    assert dc["count"] == 2   # steps 0 and 4
+    ck = snap["histograms"][
+        'dl4j_train_phase_seconds{phase="checkpoint"}']
+    assert ck["count"] == 4
+
+
+def test_phase_profiler_in_parallel_wrapper():
+    from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+    net = _net()
+    pw = ParallelWrapper(net, workers=2, phase_profiler=True)
+    x, y = _batch(0)
+    pw.fit([(x, y)] * 3)
+    rep = pw.phase_profiler.report()
+    assert rep["steps"] == 3
+    assert rep["coverage"] >= 0.95
+    assert "dispatch" in rep["phases"]
+
+
+# ============================================== recompile forensics
+def test_jit_cache_recompile_ring_captures_shape_shift():
+    """Acceptance: a deliberately shape-shifted second trace lands in
+    the forensics ring with its signature, a positive duration, and
+    the dl4j_jit_compiles_total counter."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.jit_cache import JitCache
+
+    cache = JitCache()
+
+    def f(x):
+        cache.record_trace("predict")
+        return x * 2
+
+    cache["predict"] = jax.jit(f)
+    cache["predict"](jnp.ones((4, 3), jnp.float32))
+    cache["predict"](jnp.ones((4, 3), jnp.float32))   # cache hit
+    cache["predict"](jnp.ones((8, 3), jnp.float32))   # shape shift
+    events = cache.compile_events()
+    assert len(events) == 2
+    assert events[0]["signature"] == "(float32[4,3])"
+    assert events[1]["signature"] == "(float32[8,3])"
+    assert all(e["duration_s"] > 0 for e in events)
+    assert all(e["traces"] == 1 for e in events)
+    assert cache.compiles_total() == 2
+    assert cache.total_traces() == 2
+    assert get_registry().counter_value(
+        "dl4j_jit_compiles_total") == 2
+
+
+def test_jit_cache_cost_digest_backfill_and_register_jit_entry():
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.jit_cache import JitCache
+
+    cache = JitCache()
+
+    def f(x):
+        cache.record_trace("predict")
+        return jnp.dot(x, jnp.ones((3, 3), jnp.float32))
+
+    cache["predict"] = jax.jit(f)
+    x = jnp.ones((4, 3), jnp.float32)
+    cache["predict"](x)
+    assert cache.compile_events()[0]["cost_digest"] is None
+    cm = CostModel()
+    entry = cm.register_jit_entry(cache, "predict", x)
+    assert entry is not None and entry["flops"] > 0
+    # the already-recorded ring event was backfilled...
+    ev = cache.compile_events()[0]
+    assert ev["cost_digest"]["flops"] == entry["flops"]
+    # ...and a NEW shape-shifted trace carries the digest directly
+    cache["predict"](jnp.ones((16, 3), jnp.float32))
+    assert cache.compile_events()[-1]["cost_digest"]["flops"] \
+        == entry["flops"]
+    assert cache.costs()["predict"]["flops"] == entry["flops"]
+
+
+def test_net_predict_recompile_forensics_via_trace_stats():
+    """A real net's predict path records forensics; ParallelInference
+    trace_stats surfaces them (the /status source)."""
+    net = _net()
+    net.output(np.ones((2, N_IN), np.float32))
+    net.output(np.ones((5, N_IN), np.float32))   # second specialization
+    events = net._jit_cache.compile_events()
+    assert len(events) >= 2
+    assert any("[2," in e["signature"] for e in events)
+    assert any("[5," in e["signature"] for e in events)
+
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+    pi = ParallelInference(net, batch_limit=4, warmup=False,
+                           pipeline_depth=0)
+    try:
+        stats = pi.trace_stats()
+        assert stats["compiles_total"] >= 2
+        assert len(stats["compile_events"]) >= 2
+    finally:
+        pi.shutdown()
+
+
+def test_status_surfaces_recompile_forensics():
+    """ModelServer /status answers "what recompiled": total + recent
+    events with signature/duration."""
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+    from deeplearning4j_tpu.parallel.serving import (
+        ModelClient,
+        ModelServer,
+    )
+
+    net = _net()
+    pi = ParallelInference(net, batch_limit=4, warmup=False,
+                           pipeline_depth=0)
+    server = ModelServer(pi, port=0).start()
+    try:
+        client = ModelClient(f"http://127.0.0.1:{server.port}",
+                             breaker=None)
+        client.predict(np.ones((2, N_IN), np.float32).tolist())
+        st = client.status()
+        rec = st["recompiles"]
+        assert rec["total"] >= 1
+        assert rec["recent"], "forensics ring empty on /status"
+        ev = rec["recent"][-1]
+        assert "signature" in ev and "duration_s" in ev
+    finally:
+        server.stop()
+
+
+# ======================================== cross-rank aggregation (no jax)
+def _rank_registry(steps, step_s, errors):
+    r = MetricsRegistry()
+    for i in range(steps):
+        r.inc("dl4j_train_steps_total")
+        r.observe("dl4j_train_step_seconds", step_s)
+    if errors:
+        r.inc("dl4j_serving_errors_total", errors,
+              labels={"code": "503"})
+    r.set_gauge("dl4j_perf_mfu", 0.1 * (1 + errors),
+                labels={"program": "train"})
+    return r
+
+
+def test_aggregate_snapshots_exactness():
+    """Acceptance drill (no jax): two hand-built snapshots merge to
+    exactly summed counters and merged histogram buckets/counts/sums,
+    with gauges distinguishable per rank."""
+    r0 = _rank_registry(5, 0.004, errors=0)
+    r1 = _rank_registry(7, 0.04, errors=2)
+    merged = aggregate_snapshots([
+        {"rank": 0, "snapshot": r0.snapshot()},
+        {"rank": 1, "snapshot": r1.snapshot()},
+    ])
+    assert merged["ranks"] == 2
+    assert merged["counters"]["dl4j_train_steps_total"][""] == 12
+    assert merged["counters"]["dl4j_serving_errors_total"][
+        '{code="503"}'] == 2
+    h = merged["histograms"]["dl4j_train_step_seconds"]
+    assert h["count"] == 12
+    assert h["sum"] == pytest.approx(5 * 0.004 + 7 * 0.04)
+    # buckets merged per boundary: 0.004 obs land in le=0.005, 0.04 in
+    # le=0.05 (boundary counts are per-bucket, cumulated at render)
+    assert h["buckets"]["0.005"] == 5
+    assert h["buckets"]["0.05"] == 7
+    # per-rank gauges stay distinguishable
+    g = merged["gauges"]["dl4j_perf_mfu"]
+    assert g['{program="train",rank="0"}'] == pytest.approx(0.1)
+    assert g['{program="train",rank="1"}'] == pytest.approx(0.3)
+
+
+def test_aggregate_snapshot_files_to_fleet_exposition(tmp_path):
+    """Acceptance: ≥2 per-rank snapshot FILES → one fleet-level
+    Prometheus exposition (tier-1, no jax)."""
+    paths = []
+    for rank, (steps, errs) in enumerate([(3, 1), (4, 0), (2, 2)]):
+        r = _rank_registry(steps, 0.01, errors=errs)
+        p = str(tmp_path / f"metrics-rank{rank}.json")
+        dump_snapshot(p, registry=r, rank=rank)
+        paths.append(p)
+    # dump is torn-read-proof (atomic replace): the file parses
+    assert json.loads(open(paths[0]).read())["rank"] == 0
+    text = aggregate_prometheus_text(paths)
+    assert "dl4j_train_steps_total 9" in text
+    assert 'dl4j_serving_errors_total{code="503"} 3' in text
+    assert "dl4j_train_step_seconds_count 9" in text
+    assert 'dl4j_perf_mfu{program="train",rank="2"}' in text
+    # cumulative bucket counts stay monotonic in the merged exposition
+    cums = [int(line.rsplit(" ", 1)[1])
+            for line in text.splitlines()
+            if line.startswith("dl4j_train_step_seconds_bucket")]
+    assert cums == sorted(cums) and cums[-1] == 9
+
+
+def test_cluster_supervisor_fleet_metrics(tmp_path):
+    """The supervisor's rank-0 pull path: per-rank dumps in the
+    heartbeat dir merge into one fleet view (no workers spawned)."""
+    from deeplearning4j_tpu.resilience.cluster import ClusterSupervisor
+
+    sup = ClusterSupervisor(
+        nprocs=2, command_fn=lambda *a: ["true"],
+        heartbeat_dir=str(tmp_path))
+    assert sup.fleet_metrics() is None   # nothing dumped yet
+    for rank in range(2):
+        dump_snapshot(
+            os.path.join(str(tmp_path), f"metrics-rank{rank}.json"),
+            registry=_rank_registry(6, 0.002, errors=0), rank=rank)
+    fleet = sup.fleet_metrics()
+    assert fleet["ranks"] == 2
+    assert fleet["snapshot"]["counters"][
+        "dl4j_train_steps_total"][""] == 12
+    assert "dl4j_train_steps_total 12" in fleet["prometheus"]
+    assert sup.stats()["fleet_metric_ranks"] == 2
+
+
+# ========================================================= dashboard
+def test_dashboard_perf_line_pinned():
+    """Satellite pin: the perf line (MFU, top-2 phases, recompiles)
+    renders from a registry snapshot with exact phrasing."""
+    from deeplearning4j_tpu.stats.dashboard import telemetry_lines
+
+    r = get_registry()
+    r.set_gauge("dl4j_perf_mfu", 0.42, labels={"program": "train"})
+    for _ in range(3):
+        r.observe("dl4j_train_phase_seconds", 0.030,
+                  labels={"phase": "dispatch"})
+    r.observe("dl4j_train_phase_seconds", 0.008,
+              labels={"phase": "data_wait"})
+    r.observe("dl4j_train_phase_seconds", 0.002,
+              labels={"phase": "h2d"})
+    r.inc("dl4j_jit_compiles_total", 3)
+    joined = "\n".join(telemetry_lines(r))
+    assert ("perf — MFU 0.420 · phases dispatch 90%, data_wait 8% · "
+            "3 recompiles") in joined
+    # empty registry → no perf line
+    assert all("perf —" not in line
+               for line in telemetry_lines(MetricsRegistry()))
+
+
+# ========================================================= perf gate
+def _load_perf_gate():
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "perf_gate.py")
+    spec = importlib.util.spec_from_file_location("perf_gate", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_perf_gate_verdicts(tmp_path, capsys):
+    gate = _load_perf_gate()
+
+    def write(round_n, value, metric="resnet50_train"):
+        p = tmp_path / f"BENCH_r{round_n:02d}.json"
+        p.write_text(json.dumps({"metric": metric, "value": value}))
+        return str(p)
+
+    # r05 in the driver's wrapped shape ({rc, tail, parsed}) — the
+    # real BENCH_r*.json artifacts nest the bench line under "parsed"
+    (tmp_path / "BENCH_r05.json").write_text(json.dumps({
+        "rc": 0, "tail": "...",
+        "parsed": {"metric": "resnet50_train", "value": 1000.0}}))
+    write(6, 980.0)    # -2% within default 5%
+    assert gate.main(["--dir", str(tmp_path)]) == 0
+    assert "PERF GATE PASS" in capsys.readouterr().out
+    write(7, 900.0)    # -8.2% vs r06 → fail
+    assert gate.main(["--dir", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "PERF GATE FAIL" in out and "r06" in out and "r07" in out
+    # widened tolerance passes the same pair
+    assert gate.main(["--dir", str(tmp_path),
+                      "--tolerance", "0.10"]) == 0
+    capsys.readouterr()
+    # explicit pair + metric mismatch = not comparable
+    other = tmp_path / "other.json"
+    other.write_text(json.dumps({"metric": "lenet", "value": 5.0}))
+    assert gate.main([str(tmp_path / "BENCH_r06.json"),
+                      str(other)]) == 2
+    assert "PERF GATE ERROR" in capsys.readouterr().out
+    # fewer than two rounds = skip
+    solo = tmp_path / "solo"
+    solo.mkdir()
+    write_path = solo / "BENCH_r01.json"
+    write_path.write_text(json.dumps({"metric": "m", "value": 1.0}))
+    assert gate.main(["--dir", str(solo)]) == 2
+
+
+# ============================================== concurrency sanity
+def test_jit_cache_forensics_thread_safe():
+    """Concurrent calls through the shim never corrupt the ring or
+    counters (serving completion threads share the cache)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.jit_cache import JitCache
+
+    cache = JitCache()
+
+    def f(x):
+        cache.record_trace("predict")
+        return x + 1
+
+    cache["predict"] = jax.jit(f)
+    cache["predict"](jnp.ones((2, 2)))   # compile once up front
+    barrier = threading.Barrier(4)
+
+    def hammer():
+        barrier.wait()
+        for _ in range(50):
+            cache["predict"](jnp.ones((2, 2)))
+
+    ts = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert cache.total_traces() == 1
+    assert cache.compiles_total() == 1
+    assert len(cache.compile_events()) == 1

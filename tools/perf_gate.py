@@ -1,0 +1,118 @@
+"""perf_gate: fail a perf PR that regresses the headline bench.
+
+Compares the newest BENCH_r*.json against the previous round (or two
+explicit files) on the `value` field and prints ONE verdict line:
+
+    PERF GATE PASS: resnet50_train_images_per_sec_per_chip
+        r05 2546.3 -> r06 2601.0 (+2.1%, tolerance -5.0%)
+
+Exit code 0 = pass, 1 = regression beyond tolerance, 2 = cannot
+compare (fewer than two rounds, metric mismatch, unreadable files).
+
+Usage (documented in PERF.md — every perf PR runs this):
+    python tools/perf_gate.py                      # newest vs previous
+    python tools/perf_gate.py --tolerance 0.03     # 3% budget
+    python tools/perf_gate.py --dir /path/to/repo  # artifact directory
+    python tools/perf_gate.py old.json new.json    # explicit pair
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+
+def find_rounds(directory: str):
+    """BENCH_r*.json files sorted by round number."""
+    out = []
+    for path in glob.glob(os.path.join(directory, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(path))
+        if m:
+            out.append((int(m.group(1)), path))
+    return [p for _, p in sorted(out)]
+
+
+def load_round(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    # the bench driver wraps the emitted JSON line under "parsed"
+    # ({n, cmd, rc, tail, parsed}); accept both shapes
+    if "value" not in doc and isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    return doc
+
+
+def compare(prev: dict, new: dict, tolerance: float) -> dict:
+    """Verdict dict for `new` vs `prev`: change = new/prev - 1 on the
+    `value` field; FAIL when change < -tolerance. Raises ValueError
+    when the rounds measure different metrics (not comparable)."""
+    if prev.get("metric") != new.get("metric"):
+        raise ValueError(
+            f"metric mismatch: {prev.get('metric')!r} vs "
+            f"{new.get('metric')!r} — rounds are not comparable")
+    pv, nv = float(prev["value"]), float(new["value"])
+    if pv <= 0:
+        raise ValueError(f"previous value {pv} is not positive")
+    change = nv / pv - 1.0
+    return {
+        "metric": new["metric"],
+        "prev": pv,
+        "new": nv,
+        "change": change,
+        "tolerance": tolerance,
+        "ok": change >= -tolerance,
+    }
+
+
+def _round_tag(path: str) -> str:
+    m = re.search(r"_r(\d+)\.json$", os.path.basename(path))
+    return f"r{m.group(1)}" if m else os.path.basename(path)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("files", nargs="*",
+                    help="explicit (prev, new) pair; default: the two "
+                         "newest BENCH_r*.json in --dir")
+    ap.add_argument("--tolerance", type=float, default=0.05,
+                    help="allowed throughput regression fraction "
+                         "(default 0.05 = 5%%)")
+    ap.add_argument("--dir", default=".",
+                    help="directory holding BENCH_r*.json artifacts")
+    args = ap.parse_args(argv)
+
+    if len(args.files) == 2:
+        prev_path, new_path = args.files
+    elif args.files:
+        print("PERF GATE ERROR: pass exactly two files or none")
+        return 2
+    else:
+        rounds = find_rounds(args.dir)
+        if len(rounds) < 2:
+            print(f"PERF GATE SKIP: fewer than two BENCH_r*.json "
+                  f"rounds in {args.dir} — nothing to compare")
+            return 2
+        prev_path, new_path = rounds[-2], rounds[-1]
+
+    try:
+        verdict = compare(load_round(prev_path), load_round(new_path),
+                          args.tolerance)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"PERF GATE ERROR: {e}")
+        return 2
+
+    word = "PASS" if verdict["ok"] else "FAIL"
+    print(f"PERF GATE {word}: {verdict['metric']} "
+          f"{_round_tag(prev_path)} {verdict['prev']:.1f} -> "
+          f"{_round_tag(new_path)} {verdict['new']:.1f} "
+          f"({verdict['change']:+.1%}, tolerance "
+          f"-{verdict['tolerance']:.1%})")
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
