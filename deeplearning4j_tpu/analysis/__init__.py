@@ -1,0 +1,43 @@
+"""dl4j-analyze: static invariant checker + runtime sanitizers.
+
+Three static passes (AST-only — analyzed code is parsed, never
+imported) plus an opt-in runtime lock-order sanitizer:
+
+  jit          recompile hygiene on the step/serving hot paths
+  concurrency  thread/lock discipline + the thread/lock catalog
+  conformance  fault-point / metric registries, swallow discipline,
+               test coverage of registered names
+
+Entry points:
+
+  python tools/analyze.py            # full run vs the baseline
+  python tools/analyze.py --diff     # changed files only
+  python tools/analyze.py --rules    # the rule catalog
+  DL4J_TPU_SANITIZE=locks pytest …   # runtime lock-order sanitizer
+
+This package deliberately avoids importing jax or any sibling
+subsystem so the analyzer runs in a bare interpreter.
+"""
+
+from deeplearning4j_tpu.analysis.findings import (  # noqa: F401
+    RULES,
+    Baseline,
+    Finding,
+    Rule,
+)
+from deeplearning4j_tpu.analysis.runner import (  # noqa: F401
+    AnalysisResult,
+    analyze,
+    main,
+)
+from deeplearning4j_tpu.analysis.sanitizers import (  # noqa: F401
+    LockOrderSanitizer,
+    active_sanitizer,
+    install_from_env,
+)
+
+__all__ = [
+    "RULES", "Rule", "Finding", "Baseline", "AnalysisResult",
+    "analyze", "main", "LockOrderSanitizer", "active_sanitizer",
+    "install_from_env",
+]

@@ -1,0 +1,185 @@
+"""Rule catalog, findings, and the checked-in baseline.
+
+A `Finding` is one violation of one `Rule` at one source location.
+Findings are matched against the baseline (`tools/analyze_baseline.json`)
+by *fingerprint* — rule + file + enclosing symbol + message, no line
+number — so pre-existing violations stay suppressed across unrelated
+edits while NEW violations (or an old one moving to a new function)
+fail tier-1. Baseline entries that no longer fire are reported as
+stale so the burn-down list shrinks explicitly, never silently.
+
+Inline sanctioning: a source line (or the line directly above it) may
+carry
+
+    # analyze: allow=<rule-id>[,<rule-id>] — <reason>
+
+which suppresses those rules for that statement. Pragmas are for sites
+that are *correct by design* (the StepPhaseProfiler's deliberate device
+sync, the dashboard's host-side rendering); the baseline is for debt.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+PRAGMA_RE = re.compile(r"#\s*analyze:\s*allow=([a-z0-9,\-]+)")
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    pass_name: str          # "jit" | "concurrency" | "conformance" | "runtime"
+    description: str
+
+
+_RULE_LIST = [
+    # ---- pass 1: JIT / recompile hygiene ----
+    Rule("jit-host-sync", "jit",
+         "host-sync call (.item()/.tolist()/block_until_ready/"
+         "jax.device_get/float(x.score())) in a function reachable from "
+         "the step/serving hot paths, outside the sanctioned sites"),
+    Rule("jit-missing-donate", "jit",
+         "jax.jit call site on a step-shaped function (name matches "
+         "step/update/slab) without donate_argnums/donate_argnames — "
+         "the updated buffers copy instead of aliasing"),
+    Rule("jit-traced-python-scalar", "jit",
+         "shape-derived or Python-scalar expression (x.shape[i], len(), "
+         ".ndim) passed as a traced argument to a jitted callable — "
+         "every new value retraces and recompiles the program"),
+    Rule("jit-use-after-donation", "jit",
+         "argument donated to a jitted call is read again afterwards "
+         "without being rebound — donated buffers are invalidated"),
+    # ---- pass 2: concurrency ----
+    Rule("thr-unnamed-thread", "concurrency",
+         "threading.Thread(...) without name= — anonymous threads make "
+         "hang forensics (faulthandler dumps, watchdog reports) useless"),
+    Rule("thr-non-daemon-thread", "concurrency",
+         "threading.Thread(...) that is not daemon=True — a non-daemon "
+         "background thread turns any crash into a hang at exit"),
+    Rule("thr-orphan-thread", "concurrency",
+         "thread started with no join-or-ledger shutdown path (not "
+         "bound, or bound but never joined/tracked) — shutdown cannot "
+         "prove the thread is gone"),
+    Rule("thr-blocking-under-lock", "concurrency",
+         "blocking call (sleep/open/join/socket) or metric/fault "
+         "emission while holding a registry lock — serializes the hot "
+         "path and invites lock-order inversions"),
+    # ---- pass 3: registry conformance ----
+    Rule("reg-unregistered-fault-point", "conformance",
+         'fire("...") literal not listed in faults.REGISTERED_POINTS'),
+    Rule("reg-unfired-fault-point", "conformance",
+         "REGISTERED_POINTS entry with no fire(...) site in the package"),
+    Rule("reg-unregistered-metric", "conformance",
+         "emitted or referenced dl4j_* metric literal not listed in "
+         "metrics.REGISTERED_METRICS (nor a registered-name prefix)"),
+    Rule("reg-unemitted-metric", "conformance",
+         "REGISTERED_METRICS entry (non-derived) with no emission site"),
+    Rule("reg-swallowed-exception", "conformance",
+         "bare `except Exception: pass` (or continue) without the "
+         "guarded-telemetry annotation — silent failure swallowing"),
+    Rule("reg-untested-registry-name", "conformance",
+         "registered fault point or metric name not named by any test"),
+    # ---- runtime sanitizers (DL4J_TPU_SANITIZE=locks) ----
+    Rule("san-lock-order-cycle", "runtime",
+         "cyclic lock-acquisition order observed across threads — a "
+         "potential deadlock (A held while taking B, elsewhere B held "
+         "while taking A)"),
+    Rule("san-long-held-lock", "runtime",
+         "lock held longer than the sanitizer threshold — a blocking "
+         "operation is living inside a critical section"),
+]
+
+RULES: Dict[str, Rule] = {r.id: r for r in _RULE_LIST}
+
+
+@dataclass
+class Finding:
+    rule: str
+    file: str               # repo-relative posix path
+    line: int
+    message: str            # MUST NOT embed line numbers (fingerprint)
+    symbol: str = ""        # enclosing function qualname, "" at module level
+
+    def fingerprint(self) -> str:
+        raw = f"{self.rule}|{self.file}|{self.symbol}|{self.message}"
+        return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+    def render(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.file}:{self.line}: {self.rule}{sym} {self.message}"
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "file": self.file, "line": self.line,
+                "symbol": self.symbol, "message": self.message,
+                "fingerprint": self.fingerprint()}
+
+
+# ------------------------------------------------------------- baseline
+@dataclass
+class Baseline:
+    entries: List[dict] = field(default_factory=list)
+
+    @classmethod
+    def load(cls, path) -> "Baseline":
+        with open(path) as f:
+            data = json.load(f)
+        return cls(entries=list(data.get("suppressions", [])))
+
+    def save(self, path) -> None:
+        with open(path, "w") as f:
+            json.dump({"version": 1,
+                       "note": "pre-existing dl4j-analyze findings, "
+                               "suppressed pending burn-down; new "
+                               "violations fail tier-1",
+                       "suppressions": self.entries}, f, indent=2,
+                      sort_keys=False)
+            f.write("\n")
+
+    @classmethod
+    def from_findings(cls, findings: Iterable[Finding]) -> "Baseline":
+        return cls(entries=[f.to_dict() for f in findings])
+
+    def apply(self, findings: List[Finding]
+              ) -> Tuple[List[Finding], List[Finding], List[dict]]:
+        """Split findings into (new, suppressed) and report stale
+        baseline entries. Multiplicity-aware: two identical findings
+        need two baseline entries."""
+        budget: Dict[str, int] = {}
+        for e in self.entries:
+            budget[e["fingerprint"]] = budget.get(e["fingerprint"], 0) + 1
+        new, suppressed = [], []
+        for f in findings:
+            fp = f.fingerprint()
+            if budget.get(fp, 0) > 0:
+                budget[fp] -= 1
+                suppressed.append(f)
+            else:
+                new.append(f)
+        stale = []
+        for e in self.entries:
+            if budget.get(e["fingerprint"], 0) > 0:
+                budget[e["fingerprint"]] -= 1
+                stale.append(e)
+        return new, suppressed, stale
+
+
+def parse_pragmas(text: str) -> Dict[int, set]:
+    """Map 1-based line number -> set of allowed rule ids."""
+    allow: Dict[int, set] = {}
+    for i, line in enumerate(text.splitlines(), start=1):
+        m = PRAGMA_RE.search(line)
+        if m:
+            allow[i] = {r.strip() for r in m.group(1).split(",")
+                        if r.strip()}
+    return allow
+
+
+def pragma_allows(allow: Dict[int, set], line: int, rule: str) -> bool:
+    """A pragma on the flagged line, or on the line directly above it,
+    sanctions the site."""
+    return (rule in allow.get(line, ()) or
+            rule in allow.get(line - 1, ()))

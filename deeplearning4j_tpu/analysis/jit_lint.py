@@ -1,0 +1,368 @@
+"""Pass 1 — JIT / recompile hygiene.
+
+Walks every function reachable from the step/serving hot paths (the
+`fit`/`output`/`predict` entry points, HTTP handlers, and every
+`threading.Thread` target — the batcher/completion/watchdog/flush
+thread bodies) and flags the hazards that erase compiled-path wins:
+
+  jit-host-sync            blocking device→host sync on a hot path
+  jit-missing-donate       step-shaped jax.jit without buffer donation
+  jit-traced-python-scalar shape-derived value fed to a traced arg
+  jit-use-after-donation   donated buffer read after the donating call
+
+Reachability is name-based and deliberately over-approximate: an edge
+`f -> g` exists when `f`'s body calls *any* function named `g`. False
+reachability costs a pragma; a missed hot function costs a recompile
+nobody traced.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from deeplearning4j_tpu.analysis.findings import (
+    Finding,
+    pragma_allows,
+)
+from deeplearning4j_tpu.analysis.source import (
+    SourceFile,
+    call_name,
+    dotted,
+)
+
+# entry points of the step/serving hot paths (thread targets are added
+# dynamically — every Thread body is a hot path in this codebase)
+ROOT_NAMES = {"fit", "output", "predict", "do_POST", "do_GET"}
+
+STEP_SHAPED = re.compile(r"step|update|slab")
+
+# files whose host syncs are the *instrument* (the sanctioned sites the
+# tentpole names: the StepPhaseProfiler's deliberate sampled sync)
+SANCTIONED_SYNC_FILES = ("observability/perf.py",)
+
+
+@dataclass
+class JitSite:
+    file: SourceFile
+    line: int
+    wrapped_name: str
+    bound_to: Optional[str]
+    donate: bool
+    static: bool
+    donate_argnums: Optional[Tuple[int, ...]] = None
+
+
+@dataclass
+class _FuncInfo:
+    sf: SourceFile
+    node: ast.FunctionDef
+    qualname: str
+    calls: Set[str] = field(default_factory=set)
+    thread_targets: Set[str] = field(default_factory=set)
+
+
+def _jit_kwargs(call: ast.Call) -> Tuple[bool, bool, Optional[Tuple[int, ...]]]:
+    donate = static = False
+    nums: Optional[Tuple[int, ...]] = None
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            donate = True
+            try:
+                v = ast.literal_eval(kw.value)
+                if isinstance(v, int):
+                    nums = (v,)
+                elif isinstance(v, (tuple, list)) and all(
+                        isinstance(x, int) for x in v):
+                    nums = tuple(v)
+            except (ValueError, SyntaxError):
+                nums = None
+        if kw.arg in ("static_argnums", "static_argnames"):
+            static = True
+    return donate, static, nums
+
+
+def _wrapped_name(expr) -> str:
+    """Name of the function a jax.jit call wraps, through one level of
+    combinator (jax.shard_map(worker, ...), value_and_grad(f))."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Lambda):
+        return "<lambda>"
+    if isinstance(expr, ast.Call) and expr.args:
+        return _wrapped_name(expr.args[0])
+    return ""
+
+
+def _is_jax_jit(func) -> bool:
+    d = dotted(func)
+    return d == "jax.jit" or d == "jit" or d.endswith(".jit")
+
+
+def collect_jit_sites(sources: List[SourceFile]) -> List[JitSite]:
+    sites: List[JitSite] = []
+    for sf in sources:
+        parents: Dict[int, ast.AST] = {}
+        for node in ast.walk(sf.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[id(child)] = node
+        for node in ast.walk(sf.tree):
+            # call form: jax.jit(X, ...) — possibly partial(jax.jit, ...)
+            if isinstance(node, ast.Call):
+                jit_call = None
+                wrapped = ""
+                if _is_jax_jit(node.func):
+                    jit_call = node
+                    wrapped = _wrapped_name(node.args[0]) \
+                        if node.args else ""
+                elif (isinstance(node.func, ast.Name)
+                      and node.func.id == "partial" and node.args
+                      and _is_jax_jit(node.args[0])):
+                    jit_call = node
+                    wrapped = ""          # decorator form fills it in
+                if jit_call is None:
+                    continue
+                donate, static, nums = _jit_kwargs(jit_call)
+                # decorator? the parent chain reaches a FunctionDef
+                # whose decorator_list contains us
+                parent = parents.get(id(node))
+                bound_to: Optional[str] = None
+                if isinstance(parent, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)) \
+                        and node in parent.decorator_list:
+                    wrapped = parent.name
+                    bound_to = parent.name
+                elif isinstance(parent, ast.Assign) and wrapped:
+                    t = parent.targets[0]
+                    if isinstance(t, ast.Name):
+                        bound_to = t.id
+                    elif isinstance(t, ast.Attribute):
+                        bound_to = t.attr
+                if not wrapped:
+                    continue
+                sites.append(JitSite(sf, node.lineno, wrapped, bound_to,
+                                     donate, static, nums))
+            # bare @jax.jit decorator (an Attribute, not a Call)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if not isinstance(dec, ast.Call) and _is_jax_jit(dec):
+                        sites.append(JitSite(sf, node.lineno, node.name,
+                                             node.name, False, False))
+    return sites
+
+
+# ------------------------------------------------------- reachability
+def build_reachable(sources: List[SourceFile]) -> Set[str]:
+    """Set of function qualnames reachable from the hot-path roots."""
+    funcs: List[_FuncInfo] = []
+    by_name: Dict[str, List[_FuncInfo]] = {}
+    for sf in sources:
+        for node in sf.functions():
+            fi = _FuncInfo(sf, node, f"{sf.rel}::{sf.qualname_of(node)}")
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    n = call_name(sub)
+                    if n:
+                        fi.calls.add(n)
+                    if call_name(sub) == "Thread":
+                        for kw in sub.keywords:
+                            if kw.arg == "target":
+                                tn = dotted(kw.value).split(".")[-1]
+                                if tn:
+                                    fi.thread_targets.add(tn)
+            funcs.append(fi)
+            by_name.setdefault(node.name, []).append(fi)
+
+    thread_roots: Set[str] = set()
+    for fi in funcs:
+        thread_roots |= fi.thread_targets
+    roots = [fi for fi in funcs
+             if fi.node.name in ROOT_NAMES
+             or fi.node.name in thread_roots]
+
+    seen: Set[str] = set()
+    frontier = list(roots)
+    while frontier:
+        fi = frontier.pop()
+        if fi.qualname in seen:
+            continue
+        seen.add(fi.qualname)
+        for called in fi.calls | fi.thread_targets:
+            for callee in by_name.get(called, ()):
+                if callee.qualname not in seen:
+                    frontier.append(callee)
+    return seen
+
+
+# ------------------------------------------------------------- checks
+def _host_sync_marker(node: ast.Call) -> Optional[str]:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        if f.attr == "item" and not node.args:
+            return ".item()"
+        if f.attr == "tolist" and not node.args:
+            return ".tolist()"
+        if f.attr == "block_until_ready":
+            return "block_until_ready"
+        if f.attr == "device_get":
+            return "jax.device_get"
+    if isinstance(f, ast.Name) and f.id == "float" and len(node.args) == 1:
+        a = node.args[0]
+        if isinstance(a, ast.Call) and isinstance(a.func, ast.Attribute) \
+                and a.func.attr == "score":
+            return "float(x.score())"
+    if isinstance(f, ast.Name) and f.id == "block_until_ready":
+        return "block_until_ready"
+    return None
+
+
+def run(sources: List[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    reachable = build_reachable(sources)
+    sites = collect_jit_sites(sources)
+
+    # --- jit-missing-donate -------------------------------------------
+    for s in sites:
+        if not s.donate and STEP_SHAPED.search(s.wrapped_name or ""):
+            line = s.line
+            if pragma_allows(s.file.allow, line, "jit-missing-donate"):
+                continue
+            findings.append(Finding(
+                "jit-missing-donate", s.file.rel, line,
+                f"jax.jit of step-shaped '{s.wrapped_name}' without "
+                f"donate_argnums — updated buffers copy instead of "
+                f"aliasing",
+                symbol=s.wrapped_name))
+
+    # per-module jitted identifiers
+    jitted_by_file: Dict[str, Dict[str, JitSite]] = {}
+    for s in sites:
+        if s.bound_to:
+            jitted_by_file.setdefault(s.file.rel, {})[s.bound_to] = s
+
+    for sf in sources:
+        jitted = jitted_by_file.get(sf.rel, {})
+        in_sanctioned = any(sf.rel.endswith(x)
+                            for x in SANCTIONED_SYNC_FILES)
+        for fnode in sf.functions():
+            qual = f"{sf.rel}::{sf.qualname_of(fnode)}"
+            hot = qual in reachable
+
+            # --- jit-host-sync ----------------------------------------
+            if hot and not in_sanctioned:
+                for sub in ast.walk(fnode):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    marker = _host_sync_marker(sub)
+                    if marker is None:
+                        continue
+                    if pragma_allows(sf.allow, sub.lineno,
+                                     "jit-host-sync"):
+                        continue
+                    findings.append(Finding(
+                        "jit-host-sync", sf.rel, sub.lineno,
+                        f"{marker} forces a device->host sync on a "
+                        f"hot path (reachable from "
+                        f"{'/'.join(sorted(ROOT_NAMES))} or a thread "
+                        f"body)",
+                        symbol=sf.qualname_of(fnode)))
+
+            # --- jit-traced-python-scalar -----------------------------
+            for sub in ast.walk(fnode):
+                if not isinstance(sub, ast.Call):
+                    continue
+                cn = call_name(sub)
+                site = jitted.get(cn)
+                if site is None or site.static:
+                    continue
+                for arg in sub.args:
+                    label = _scalar_shaped(arg)
+                    if label is None:
+                        continue
+                    if pragma_allows(sf.allow, sub.lineno,
+                                     "jit-traced-python-scalar"):
+                        continue
+                    findings.append(Finding(
+                        "jit-traced-python-scalar", sf.rel, sub.lineno,
+                        f"{label} passed as a traced argument to "
+                        f"jitted '{cn}' — each new value retraces "
+                        f"and recompiles",
+                        symbol=sf.qualname_of(fnode)))
+
+            # --- jit-use-after-donation -------------------------------
+            findings.extend(_use_after_donation(sf, fnode, jitted))
+    return findings
+
+
+def _scalar_shaped(arg) -> Optional[str]:
+    if isinstance(arg, ast.Subscript) \
+            and isinstance(arg.value, ast.Attribute) \
+            and arg.value.attr == "shape":
+        return f"{dotted(arg.value)}[...]"
+    if isinstance(arg, ast.Attribute) and arg.attr in ("ndim", "size"):
+        return dotted(arg)
+    if isinstance(arg, ast.Call) and isinstance(arg.func, ast.Name) \
+            and arg.func.id == "len":
+        return "len(...)"
+    return None
+
+
+def _use_after_donation(sf: SourceFile, fnode,
+                        jitted: Dict[str, "JitSite"]) -> List[Finding]:
+    donating = {k: s for k, s in jitted.items() if s.donate}
+    if not donating:
+        return []
+    loads: List[Tuple[int, str]] = []
+    stores: List[Tuple[int, str]] = []
+    calls: List[Tuple[int, str, ast.Call, Set[str]]] = []
+    for sub in ast.walk(fnode):
+        if isinstance(sub, ast.Name):
+            if isinstance(sub.ctx, ast.Load):
+                loads.append((sub.lineno, sub.id))
+            else:
+                stores.append((sub.lineno, sub.id))
+        if isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call):
+            cn = call_name(sub.value)
+            if cn in donating:
+                targets: Set[str] = set()
+                for t in sub.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            targets.add(n.id)
+                calls.append((sub.lineno, cn, sub.value, targets))
+
+    findings: List[Finding] = []
+    for call_line, cn, call, rebound in calls:
+        site = donating[cn]
+        positions = site.donate_argnums
+        args = call.args
+        donated_names = []
+        for i, a in enumerate(args):
+            if positions is not None and i not in positions:
+                continue
+            if isinstance(a, ast.Name):
+                donated_names.append(a.id)
+        for name in donated_names:
+            if name in rebound:
+                continue
+            later_loads = [ln for ln, nm in loads
+                           if nm == name and ln > call_line]
+            for ln in sorted(later_loads):
+                restored = any(sl for sl, nm in stores
+                               if nm == name and call_line < sl <= ln)
+                if restored:
+                    break
+                if pragma_allows(sf.allow, ln, "jit-use-after-donation"):
+                    break
+                findings.append(Finding(
+                    "jit-use-after-donation", sf.rel, ln,
+                    f"'{name}' was donated to jitted '{cn}' and read "
+                    f"again without being rebound — the buffer is "
+                    f"invalid after donation",
+                    symbol=sf.qualname_of(fnode)))
+                break
+    return findings

@@ -1,0 +1,328 @@
+"""Runtime concurrency sanitizer: lock-order + long-held-lock.
+
+`LockOrderSanitizer.install()` replaces the `threading.Lock` /
+`threading.RLock` factories with proxy-producing ones. Every proxy
+knows its *creation site* (file:line), and every acquisition records
+one edge per lock already held by the acquiring thread:
+
+    held A, acquiring B   =>   edge  A -> B
+
+Two threads acquiring the same pair in opposite orders produce the
+cycle A -> B -> A — a potential deadlock even if the interleaving
+never actually wedged this run. That is the point: the sanitizer turns
+"we got lucky this time" into a failed test. It also flags locks held
+longer than `long_hold_s` (a blocking operation living inside a
+critical section — the runtime twin of thr-blocking-under-lock).
+
+Enable for a test run (the chaos-sweep recipe) with
+
+    DL4J_TPU_SANITIZE=locks python -m pytest tests/ -m chaos
+
+tests/conftest.py installs the sanitizer at session start when the env
+var is set and fails any test on whose watch a new cycle appeared.
+Only locks created *after* install() are tracked; the production
+threads (batcher/completion/watchdog/flush) all create their locks at
+object construction time, so constructing the system under test with
+the sanitizer armed covers them.
+
+Edges aggregate by creation site, not lock instance, so an A→B/B→A
+inversion between two *instances* of the same pair of sites is still a
+cycle — exactly how native lock-order sanitizers (e.g. TSan's deadlock
+detector) aggregate.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+ENV_VAR = "DL4J_TPU_SANITIZE"
+
+# real factories, captured before any install() can patch them
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+_ACTIVE: Optional["LockOrderSanitizer"] = None
+
+
+def _creation_frame(skip_files: Tuple[str, ...]):
+    """(path, lineno) of the first frame outside this module and
+    threading.py — the lock's creation site."""
+    for frame in reversed(traceback.extract_stack()):
+        fn = frame.filename
+        if fn.endswith(skip_files) or "threading.py" in fn:
+            continue
+        return fn, frame.lineno
+    return "<unknown>", 0
+
+
+@dataclass
+class _Held:
+    proxy: "_LockProxy"
+    count: int
+    t0: float
+
+
+class _HeldStack(threading.local):
+    def __init__(self):
+        self.stack: List[_Held] = []
+
+
+@dataclass
+class Edge:
+    src: str
+    dst: str
+    thread: str
+    stack: str = ""
+
+
+@dataclass
+class LongHold:
+    site: str
+    duration_s: float
+    thread: str
+
+
+class _LockProxy:
+    """Wraps one real lock; reports acquisitions to the sanitizer."""
+
+    _SAN_IS_RLOCK = False
+
+    def __init__(self, san: "LockOrderSanitizer", inner, site: str):
+        self._san = san
+        self._inner = inner
+        self._site = site
+
+    # ------------------------------------------------------- lock API
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._san._note_acquire(self)
+        return got
+
+    def release(self):
+        self._san._note_release(self)
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<sanitized {type(self._inner).__name__} @{self._site}>"
+
+
+class _RLockProxy(_LockProxy):
+    _SAN_IS_RLOCK = True
+
+    # Condition-variable protocol: keep the sanitizer's held-stack
+    # accounting exact across cond.wait()'s full release/re-acquire
+    def _release_save(self):
+        state = self._inner._release_save()
+        self._san._note_release(self, all_levels=True)
+        return state
+
+    def _acquire_restore(self, state):
+        self._inner._acquire_restore(state)
+        self._san._note_acquire(self)
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+    def locked(self):
+        locked = getattr(self._inner, "locked", None)
+        return locked() if locked is not None else False
+
+
+class LockOrderSanitizer:
+    """Build the cross-thread lock-acquisition graph; detect cycles
+    (potential deadlocks) and long-held locks."""
+
+    # only locks created from files matching these substrings are
+    # proxied. Scoping matters beyond noise: stdlib internals
+    # (queue.Queue's mutex, executor work queues) are waited on by
+    # daemon threads straight through interpreter finalization, where
+    # a pure-Python acquire frame is a fatal error — those must stay
+    # real C locks.
+    DEFAULT_SCOPE = ("deeplearning4j_tpu", "test")
+
+    def __init__(self, long_hold_s: float = 1.0,
+                 scope: Tuple[str, ...] = DEFAULT_SCOPE):
+        self.long_hold_s = float(long_hold_s)
+        self.scope = tuple(scope)
+        self._meta = _REAL_LOCK()
+        self._edges: Dict[Tuple[str, str], Edge] = {}
+        self._long_holds: List[LongHold] = []
+        self._held = _HeldStack()
+        self._installed = False
+        self._skip = (os.path.abspath(__file__),)
+
+    # -------------------------------------------------------- install
+    def install(self) -> "LockOrderSanitizer":
+        global _ACTIVE
+        if self._installed:
+            return self
+        san = self
+
+        def make_lock():
+            path, lineno = _creation_frame(san._skip)
+            if not any(p in path for p in san.scope):
+                return _REAL_LOCK()
+            return _LockProxy(san, _REAL_LOCK(),
+                              f"{os.path.basename(path)}:{lineno}")
+
+        def make_rlock():
+            path, lineno = _creation_frame(san._skip)
+            if not any(p in path for p in san.scope):
+                return _REAL_RLOCK()
+            return _RLockProxy(san, _REAL_RLOCK(),
+                               f"{os.path.basename(path)}:{lineno}")
+
+        threading.Lock = make_lock
+        threading.RLock = make_rlock
+        self._installed = True
+        _ACTIVE = self
+        return self
+
+    def uninstall(self) -> None:
+        global _ACTIVE
+        if not self._installed:
+            return
+        threading.Lock = _REAL_LOCK
+        threading.RLock = _REAL_RLOCK
+        self._installed = False
+        if _ACTIVE is self:
+            _ACTIVE = None
+
+    # ----------------------------------------------------- accounting
+    def _note_acquire(self, proxy: _LockProxy) -> None:
+        stack = self._held.stack
+        for held in stack:
+            if held.proxy is proxy:          # RLock re-entry: no edge
+                held.count += 1
+                return
+        now = time.perf_counter()
+        if stack:
+            src = stack[-1].proxy._site
+            dst = proxy._site
+            if src != dst:
+                key = (src, dst)
+                if key not in self._edges:
+                    tb = "".join(traceback.format_stack(limit=8)[:-2])
+                    with self._meta:
+                        if key not in self._edges:
+                            self._edges[key] = Edge(
+                                src, dst,
+                                threading.current_thread().name, tb)
+        stack.append(_Held(proxy, 1, now))
+
+    def _note_release(self, proxy: _LockProxy,
+                      all_levels: bool = False) -> None:
+        stack = self._held.stack
+        for i in range(len(stack) - 1, -1, -1):
+            held = stack[i]
+            if held.proxy is not proxy:
+                continue
+            held.count -= 1
+            if all_levels:
+                held.count = 0
+            if held.count <= 0:
+                dur = time.perf_counter() - held.t0
+                if dur >= self.long_hold_s:
+                    with self._meta:
+                        self._long_holds.append(LongHold(
+                            proxy._site, dur,
+                            threading.current_thread().name))
+                stack.pop(i)
+            return
+
+    # -------------------------------------------------------- reports
+    def edges(self) -> List[Edge]:
+        with self._meta:
+            return list(self._edges.values())
+
+    def cycles(self) -> List[List[str]]:
+        """Simple cycles in the site graph, each reported once in
+        canonical rotation (smallest site first)."""
+        with self._meta:
+            adj: Dict[str, Set[str]] = {}
+            for (src, dst) in self._edges:
+                adj.setdefault(src, set()).add(dst)
+        out: Set[Tuple[str, ...]] = set()
+
+        def dfs(start: str, node: str, path: List[str],
+                visited: Set[str]) -> None:
+            for nxt in sorted(adj.get(node, ())):
+                if nxt == start and len(path) > 1:
+                    i = path.index(min(path))
+                    out.add(tuple(path[i:] + path[:i]))
+                elif nxt not in visited and len(path) < 16:
+                    visited.add(nxt)
+                    dfs(start, nxt, path + [nxt], visited)
+                    visited.discard(nxt)
+
+        for start in sorted(adj):
+            dfs(start, start, [start], {start})
+        return [list(c) for c in sorted(out)]
+
+    def long_holds(self) -> List[LongHold]:
+        with self._meta:
+            return list(self._long_holds)
+
+    def violations(self) -> List[dict]:
+        """Findings-shaped dicts for the two runtime rules."""
+        out = []
+        for cyc in self.cycles():
+            out.append({
+                "rule": "san-lock-order-cycle",
+                "sites": cyc,
+                "message": "cyclic lock order " +
+                           " -> ".join(cyc + [cyc[0]]) +
+                           " — potential deadlock",
+            })
+        for lh in self.long_holds():
+            out.append({
+                "rule": "san-long-held-lock",
+                "sites": [lh.site],
+                "message": f"lock at {lh.site} held "
+                           f"{lh.duration_s:.3f}s by {lh.thread} "
+                           f"(threshold {self.long_hold_s:.3f}s)",
+            })
+        return out
+
+    def reset(self) -> None:
+        with self._meta:
+            self._edges.clear()
+            self._long_holds.clear()
+
+
+# ------------------------------------------------------------- wiring
+def active_sanitizer() -> Optional[LockOrderSanitizer]:
+    return _ACTIVE
+
+
+def enabled_modes() -> Set[str]:
+    raw = os.environ.get(ENV_VAR, "")
+    return {m.strip() for m in raw.split(",") if m.strip()}
+
+
+def install_from_env(long_hold_s: float = 1.0
+                     ) -> Optional[LockOrderSanitizer]:
+    """Install the lock sanitizer iff DL4J_TPU_SANITIZE names `locks`.
+    Returns the active sanitizer (new or pre-existing) or None."""
+    if "locks" not in enabled_modes():
+        return None
+    if _ACTIVE is not None:
+        return _ACTIVE
+    return LockOrderSanitizer(long_hold_s=long_hold_s).install()

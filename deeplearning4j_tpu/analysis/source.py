@@ -1,0 +1,116 @@
+"""Shared AST plumbing for the static passes.
+
+Everything here is stdlib-only and import-free with respect to the
+analyzed code: files are *parsed*, never executed, so `tools/analyze.py`
+runs in well under a second with no jax (or any other dependency) in
+the process.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional
+
+from deeplearning4j_tpu.analysis.findings import parse_pragmas
+
+
+@dataclass
+class SourceFile:
+    path: Path
+    rel: str                       # repo-relative posix path
+    text: str
+    tree: ast.Module
+    allow: Dict[int, set] = field(default_factory=dict)
+    # node -> enclosing function qualname ("" at module level)
+    _qualnames: Dict[int, str] = field(default_factory=dict)
+
+    @classmethod
+    def parse(cls, path: Path, root: Path) -> Optional["SourceFile"]:
+        try:
+            text = path.read_text()
+            tree = ast.parse(text, filename=str(path))
+        except (SyntaxError, UnicodeDecodeError, OSError):
+            return None
+        try:
+            rel = path.resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:
+            rel = path.as_posix()
+        sf = cls(path=path, rel=rel, text=text, tree=tree,
+                 allow=parse_pragmas(text))
+        sf._annotate_qualnames()
+        return sf
+
+    # ---------------------------------------------------------- helpers
+    def _annotate_qualnames(self) -> None:
+        def visit(node, stack):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    q = ".".join(stack + [child.name])
+                    self._qualnames[id(child)] = q
+                    visit(child, stack + [child.name])
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, stack + [child.name])
+                else:
+                    self._qualnames[id(child)] = ".".join(stack)
+                    visit(child, stack)
+        visit(self.tree, [])
+
+    def qualname_of(self, node) -> str:
+        return self._qualnames.get(id(node), "")
+
+    def functions(self) -> Iterator[ast.FunctionDef]:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+
+def iter_py_files(pkg_dir: Path) -> List[Path]:
+    return sorted(p for p in pkg_dir.rglob("*.py")
+                  if "__pycache__" not in p.parts)
+
+
+def load_sources(pkg_dir: Path, root: Path,
+                 only: Optional[set] = None) -> List[SourceFile]:
+    """Parse every .py file under `pkg_dir`. `only` (repo-relative
+    posix paths) restricts the list — used by --diff mode."""
+    out = []
+    for p in iter_py_files(pkg_dir):
+        sf = SourceFile.parse(p, root)
+        if sf is None:
+            continue
+        if only is not None and sf.rel not in only:
+            continue
+        out.append(sf)
+    return out
+
+
+# ------------------------------------------------------- name helpers
+def call_name(node: ast.Call) -> str:
+    """Last identifier of the callee: foo() -> foo, a.b.foo() -> foo."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def dotted(node) -> str:
+    """Best-effort dotted name of an expression (jax.jit, self._lock)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    if isinstance(node, ast.Call):
+        return dotted(node.func) + "()"
+    return ""
+
+
+def const_str(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None

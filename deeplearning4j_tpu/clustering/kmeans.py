@@ -38,7 +38,7 @@ class ClusterSet:
         return np.stack([c.center for c in self.clusters])
 
 
-@partial(jax.jit, static_argnames=("metric",))
+@partial(jax.jit, static_argnames=("metric",), donate_argnums=(1,))
 def _lloyd_step(points, centers, metric):
     d = pairwise_distance(points, centers, metric)
     assign = jnp.argmin(d, axis=1)

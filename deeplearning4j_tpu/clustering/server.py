@@ -90,7 +90,8 @@ class NearestNeighborsServer:
         self._httpd = _Server((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True)
+            target=self._httpd.serve_forever, daemon=True,
+            name="NearestNeighborsServer-http")
         self._thread.start()
         return self
 
@@ -99,6 +100,9 @@ class NearestNeighborsServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
 
 
 class NearestNeighborsClient:

@@ -117,7 +117,8 @@ def _symmetrize_block(idx_blk, p_blk, row0, idx_all, p_all,
     return (p_blk + p_back) / (2.0 * n_total), mutual
 
 
-@partial(jax.jit, static_argnames=("row_block", "n_real"))
+@partial(jax.jit, static_argnames=("row_block", "n_real"),
+         donate_argnums=(0,))
 def _chunked_step(y, idx, psym, mutual, exaggeration, row_block: int,
                   n_real: int):
     """One gradient iteration with the repulsive term streamed over

@@ -38,6 +38,7 @@ class Normalizer:
     def to_dict(self) -> dict:
         d = {"type": type(self).__name__}
         for k, v in self.__dict__.items():
+            # analyze: allow=jit-host-sync — host-numpy stats serialization
             d[k] = v.tolist() if isinstance(v, np.ndarray) else v
         return d
 

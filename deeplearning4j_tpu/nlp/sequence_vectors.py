@@ -945,8 +945,9 @@ class SequenceVectors:
             except BaseException as e:   # re-raised below
                 put((ERR, e))
 
-        threading.Thread(target=run, daemon=True,
-                         name="w2v-slab-packer").start()
+        packer = threading.Thread(target=run, daemon=True,
+                                  name="w2v-slab-packer")
+        packer.start()
 
         def drain():
             try:
@@ -960,6 +961,9 @@ class SequenceVectors:
                     yield item
             finally:
                 stop.set()
+                # stop flag makes every pending put() bail within one
+                # timeout tick, so this join is bounded
+                packer.join(timeout=2.0)
 
         return drain()
 

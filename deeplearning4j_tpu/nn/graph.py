@@ -639,6 +639,11 @@ class ComputationGraph:
         lines.append(f"Total parameters: {total:,}")
         return "\n".join(lines)
 
+    def raw_score(self):
+        """Last training loss WITHOUT the device->host sync `score()`
+        pays (see MultiLayerNetwork.raw_score)."""
+        return self._score
+
     def score(self, data=None):
         if data is None:
             return None if self._score is None else float(self._score)

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
+from functools import partial
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -476,6 +477,13 @@ class MultiLayerNetwork:
         """Argmax class predictions."""
         return jnp.argmax(self.output(x), axis=-1)
 
+    def raw_score(self):
+        """Last training loss WITHOUT the device->host sync `score()`
+        pays: returns the device scalar (or None). Hot-loop consumers
+        (CollectScoresIterationListener) keep the scalar and float()
+        it off the hot path."""
+        return self._score
+
     def score(self, data=None, labels=None):
         """Loss on a dataset (or last training score if no args)."""
         if data is None:
@@ -593,7 +601,7 @@ class MultiLayerNetwork:
             def loss_fn(lp, x, rng):
                 return layer.pretrain_loss(lp, x, rng)
 
-            @jax.jit
+            @partial(jax.jit, donate_argnums=(0, 1))
             def pre_step(lp, us, step, x, rng):
                 loss, grads = jax.value_and_grad(loss_fn)(lp, x, rng)
                 lr = schedule_lr(self.conf, step)

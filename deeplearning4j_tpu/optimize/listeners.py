@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import logging
 import time
-from typing import Callable, List, Optional, Tuple
+from typing import Any, Callable, List, Optional, Tuple
 
 logger = logging.getLogger("deeplearning4j_tpu")
 
@@ -122,20 +122,35 @@ class EvaluativeListener:
 
 class CollectScoresIterationListener:
     """Accumulate (iteration, score) pairs
-    (ref: CollectScoresIterationListener.java)."""
+    (ref: CollectScoresIterationListener.java).
+
+    Deferred materialization (dl4j-analyze jit-host-sync burn-down):
+    `model.score()` pays a device->host sync, so calling it every
+    recorded iteration put a blocking fetch inside the hot loop.
+    `scores` now holds the *device scalar* (via `model.raw_score()`
+    when available); `get_scores()` / `export_scores()` pay the syncs
+    once, at read time, off the hot path."""
 
     def __init__(self, frequency: int = 1):
         self.frequency = max(1, frequency)
-        self.scores: List[Tuple[int, float]] = []
+        self.scores: List[Tuple[int, Any]] = []
 
     def iteration_done(self, model, iteration: int):
         if iteration % self.frequency == 0:
-            self.scores.append((iteration, model.score()))
+            raw = getattr(model, "raw_score", None)
+            self.scores.append(
+                (iteration, raw() if raw is not None else model.score()))
+
+    def get_scores(self) -> List[Tuple[int, Optional[float]]]:
+        """Materialized [(iteration, float score), ...] — the device
+        syncs happen here, not per training iteration."""
+        return [(it, None if s is None else float(s))
+                for it, s in self.scores]
 
     def export_scores(self, path, delimiter=","):
         with open(path, "w") as f:
             f.write(f"iteration{delimiter}score\n")
-            for it, s in self.scores:
+            for it, s in self.get_scores():
                 f.write(f"{it}{delimiter}{s}\n")
 
 
@@ -375,6 +390,7 @@ class ProfilerListener:
         elif self._active and iteration >= self.stop_at:
             # force pending device work into the traced window
             if model.score() is not None:
+                # analyze: allow=jit-host-sync — deliberate trace flush
                 float(model.score())
             self.stop()
 
@@ -384,6 +400,7 @@ class ProfilerListener:
         here instead of leaking into teardown."""
         if self._active:
             if model is not None and model.score() is not None:
+                # analyze: allow=jit-host-sync — deliberate trace flush
                 float(model.score())
             self.stop()
 

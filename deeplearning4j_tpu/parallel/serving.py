@@ -241,9 +241,13 @@ class ModelServer:
         with self._served_lock:
             self._served += xs[0].shape[0]
         multi = isinstance(out, list)
+        # JSON response serialization: the completion stage already
+        # paid the device fetch, so these are host-numpy copies
+        outputs = (
+            [np.asarray(o).tolist() for o in out]  # analyze: allow=jit-host-sync
+            if multi else np.asarray(out).tolist())
         resp = {
-            "outputs": ([np.asarray(o).tolist() for o in out]
-                        if multi else np.asarray(out).tolist()),
+            "outputs": outputs,
             "model": entry.name,
             "version": version,
         }
@@ -553,7 +557,8 @@ class ModelServer:
         self._httpd = _Server((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True)
+            target=self._httpd.serve_forever, daemon=True,
+            name="ModelServer-http")
         self._thread.start()
         self._ready = True
         return self
@@ -564,6 +569,9 @@ class ModelServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
         if self._owns_registry:
             # the registry shuts down only the ParallelInference
             # front-ends it built — never a caller-supplied one

@@ -189,8 +189,8 @@ class TrainingMaster:
         try:
             jax.config.update(
                 "jax_cpu_collectives_implementation", "gloo")
-        except Exception:
-            pass   # non-CPU platforms configure their own collectives
+        except Exception:   # noqa: BLE001 - non-CPU platforms configure
+            pass            # their own collectives; flag absent there
         jax.distributed.initialize(coordinator_address,
                                    num_processes=num_processes,
                                    process_id=process_id)
@@ -444,6 +444,7 @@ class TrainingMaster:
                     f"{step} (policy=abort)")
         if collect_training_stats:
             # host fetch = true step barrier for honest timing
+            # analyze: allow=jit-host-sync — opt-in stats mode only
             float(net.score())
         t2 = time.perf_counter()
         if tr is not None and (check_now or collect_training_stats):
@@ -692,6 +693,7 @@ class TrainingMaster:
                             f"{verdict} training state in group at "
                             f"step {step} (policy=abort)")
                 if collect_training_stats:
+                    # analyze: allow=jit-host-sync — opt-in stats barrier
                     float(net.score())
                 t2 = time.perf_counter()
                 # group telemetry: steps_total counts the inner steps

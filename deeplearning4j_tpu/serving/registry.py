@@ -147,6 +147,7 @@ class ModelEntry:
         warmed by the time this runs; the old active becomes the warm
         `standby` rollback target and keeps draining its in-flight
         window on its own still-running batcher."""
+        swapped = False
         with self._lock:
             if version not in self.versions:
                 raise ModelNotFoundError(
@@ -161,12 +162,16 @@ class ModelEntry:
             old = self.active
             if old is not None:
                 self.versions[old].state = STANDBY
-                _obs.count("dl4j_serving_swaps_total",
-                           labels={"model": self.name})
+                swapped = True
             self.active = version
             self.previous = old
             ver.state = ACTIVE
             self._trim_standbys()
+        # emission stays outside the registry lock (dl4j-analyze
+        # thr-blocking-under-lock): the obs registry takes its own lock
+        if swapped:
+            _obs.count("dl4j_serving_swaps_total",
+                       labels={"model": self.name})
 
     def rollback(self) -> str:
         with self._lock:
@@ -187,9 +192,9 @@ class ModelEntry:
             ver.state = ACTIVE
             if old is not None:
                 self.versions[old].state = STANDBY
-            _obs.count("dl4j_serving_rollbacks_total",
-                       labels={"model": self.name})
-            return target
+        _obs.count("dl4j_serving_rollbacks_total",
+                   labels={"model": self.name})
+        return target
 
     def _trim_standbys(self) -> None:
         """Retire standbys beyond keep_warm (called under the lock).
@@ -281,6 +286,7 @@ class ModelRegistry:
             return sorted(self._entries)
 
     def _entry_or_create(self, name: str) -> ModelEntry:
+        created_n = None
         with self._lock:
             if self._closed:
                 raise ModelNotFoundError("registry is shut down")
@@ -289,9 +295,10 @@ class ModelRegistry:
                 e = self._entries[name] = ModelEntry(name, self)
                 if self._default is None:
                     self._default = name
-                _obs.set_gauge("dl4j_serving_active_models",
-                               len(self._entries))
-            return e
+                created_n = len(self._entries)
+        if created_n is not None:
+            _obs.set_gauge("dl4j_serving_active_models", created_n)
+        return e
 
     # ------------------------------------------------------- register
     def register(self, name: str, net_or_pi, version: Optional[str] = None,
@@ -388,8 +395,8 @@ class ModelRegistry:
             del self._entries[name]
             if self._default == name:
                 self._default = next(iter(sorted(self._entries)), None)
-            _obs.set_gauge("dl4j_serving_active_models",
-                           len(self._entries))
+            remaining = len(self._entries)
+        _obs.set_gauge("dl4j_serving_active_models", remaining)
         with e._lock:
             vers = list(e.versions.values())
             e.versions.clear()

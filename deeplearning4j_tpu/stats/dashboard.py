@@ -538,7 +538,8 @@ class UIServer:
         self._httpd = _Server((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True)
+            target=self._httpd.serve_forever, daemon=True,
+            name="UIServer-http")
         self._thread.start()
         return self
 
@@ -547,3 +548,6 @@ class UIServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None

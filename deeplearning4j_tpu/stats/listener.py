@@ -32,6 +32,14 @@ def jnp_array(a):
     return jnp.array(a)
 
 
+def _score_once(model):
+    """At most ONE score() call per report: score() pays a device->host
+    sync, and the old `None if model.score() is None else
+    float(model.score())` paid it twice (dl4j-analyze jit-host-sync)."""
+    s = model.score()
+    return None if s is None else float(s)
+
+
 def _named_leaves(params):
     """Flatten params into [(group_name, leaf), ...] with stable names
     like '0/W' (list container) or 'conv1/gamma' (dict container)."""
@@ -151,7 +159,7 @@ class StatsListener:
             worker_id=self.worker_id,
             iteration=iteration,
             epoch=getattr(model, "epoch", 0),
-            score=None if model.score() is None else float(model.score()),
+            score=_score_once(model),
             batches_per_sec=batches_per_sec,
             samples_per_sec=(batches_per_sec * batch
                              if batches_per_sec and batch else None),
@@ -182,6 +190,6 @@ class StatsListener:
                     "bytes_in_use", 0) / 1e6
                 mem["device_limit_mb"] = st.get(
                     "bytes_limit", 0) / 1e6
-        except Exception:
-            pass
+        except Exception:   # noqa: BLE001 - device memory stats are
+            pass            # best-effort (no backend / no stats API)
         return mem

@@ -119,6 +119,42 @@ def _clear_faults():
     injector().clear()
 
 
+def pytest_configure(config):
+    """DL4J_TPU_SANITIZE=locks arms the runtime lock-order sanitizer
+    for the whole session (the sanitized chaos-sweep recipe in
+    pytest.ini): every threading.Lock/RLock created from here on is
+    tracked, and _lock_order_check below fails any test on whose
+    watch a new acquisition-order cycle appeared."""
+    if os.environ.get("DL4J_TPU_SANITIZE"):
+        from deeplearning4j_tpu.analysis import sanitizers
+
+        sanitizers.install_from_env()
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_check(request):
+    """With the sanitizer armed, a test that introduces a lock-order
+    cycle (potential deadlock) FAILS — even if the interleaving never
+    actually wedged this run."""
+    if not os.environ.get("DL4J_TPU_SANITIZE"):
+        yield
+        return
+    from deeplearning4j_tpu.analysis import sanitizers
+
+    san = sanitizers.active_sanitizer()
+    if san is None or "test_static_analysis" in request.node.nodeid:
+        # the sanitizer's own drills construct cycles on purpose
+        yield
+        return
+    before = {tuple(c) for c in san.cycles()}
+    yield
+    new = [c for c in san.cycles() if tuple(c) not in before]
+    if new:
+        pytest.fail(
+            "lock-order sanitizer: new acquisition cycle(s) "
+            f"(potential deadlock): {new}")
+
+
 @pytest.fixture(autouse=True)
 def _restore_signal_handlers():
     """Chaos isolation for signals: preemption/watchdog tests install

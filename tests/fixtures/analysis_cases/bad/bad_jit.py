@@ -1,0 +1,36 @@
+"""Known JIT-hygiene violations (true-positive fixtures; parsed only).
+
+- `train_step` is step-shaped and jitted without donation
+  -> jit-missing-donate
+- `fit` (a hot-path root) calls .item() -> jit-host-sync
+- `fit` passes xs.shape[0] and len(xs) to a jitted callable
+  -> jit-traced-python-scalar
+- `fit` reads `params` after donating it -> jit-use-after-donation
+"""
+
+import jax
+
+
+def step_fn(params, x):
+    return params
+
+
+train_step = jax.jit(step_fn)
+
+donating_step = jax.jit(step_fn, donate_argnums=(0,))
+
+
+def fit(params, xs):
+    out = train_step(params, xs)
+    probe = xs.item()
+    bad_a = train_step(params, xs.shape[0])
+    bad_b = train_step(params, len(xs))
+    donated = donating_step(params, xs)
+    leaked = params
+    return out, probe, bad_a, bad_b, donated, leaked
+
+
+def cold_helper(xs):
+    # NOT reachable from any root: .item() here must not be flagged
+    # (false-positive guard for the reachability walk)
+    return xs.item()

@@ -1,0 +1,33 @@
+"""Known conformance violations (true-positive fixtures).
+
+Expected: reg-unregistered-fault-point (line 12),
+reg-unregistered-metric (line 16), reg-swallowed-exception (line 22).
+"""
+
+
+def fire_unregistered():
+    # the conformance pass resolves `fire` by name, no import needed
+    fire("not.registered")          # noqa: F821
+
+
+def fire_registered():
+    fire("known.point")             # noqa: F821
+
+
+def emit_ok_and_bogus():
+    count("dl4j_train_known_total")     # noqa: F821
+    count("dl4j_train_bogus_total")     # noqa: F821
+
+
+def swallow_everything(risky):
+    try:
+        risky()
+    except Exception:
+        pass
+
+
+def swallow_annotated(risky):
+    try:
+        risky()
+    except Exception:   # noqa: BLE001 - fixture: annotated swallow is OK
+        pass

@@ -1,0 +1,23 @@
+"""Known concurrency violations (true-positive fixtures; parsed only)."""
+
+import threading
+import time
+
+from deeplearning4j_tpu.observability import metrics as _obs
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+
+    def start(self):
+        t = threading.Thread(target=self._run)
+        t.start()
+        threading.Thread(target=self._run, daemon=True,
+                         name="bad-fire-and-forget").start()
+
+    def _run(self):
+        with self._lock:
+            time.sleep(0.1)
+            _obs.count("dl4j_train_known_total")

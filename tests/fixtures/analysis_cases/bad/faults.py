@@ -1,0 +1,13 @@
+"""Fixture registry (parsed by the conformance pass, never imported).
+
+`never.fired` has no fire site -> reg-unfired-fault-point.
+"""
+
+REGISTERED_POINTS = frozenset({
+    "known.point",
+    "never.fired",
+})
+
+
+def fire(point, path=None):
+    pass

@@ -1,0 +1,12 @@
+"""Fixture metric registry (parsed only).
+
+`dl4j_train_never_emitted_total` has no emission site ->
+reg-unemitted-metric.
+"""
+
+REGISTERED_METRICS = frozenset({
+    "dl4j_train_known_total",
+    "dl4j_train_never_emitted_total",
+})
+
+DERIVED_METRICS = frozenset()

@@ -1,0 +1,51 @@
+"""Well-formed module: the false-positive guard for every rule.
+
+Named daemon thread with a join in stop(), donated-and-rebound jit
+step, registered fault point and metric, annotated guard swallow —
+the analyzer must report NOTHING here.
+"""
+
+import threading
+
+import jax
+
+from deeplearning4j_tpu.observability import metrics as _obs
+
+
+def step_fn(params, x):
+    return params
+
+
+train_step = jax.jit(step_fn, donate_argnums=(0,))
+
+
+def fit(params, xs):
+    params = train_step(params, xs)
+    fire("clean.point")             # noqa: F821
+    _obs.count("dl4j_train_clean_total")
+    return params
+
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = None
+        self._items = []
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="clean-pump")
+        self._thread.start()
+
+    def stop(self):
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)
+            self._thread = None
+
+    def _run(self):
+        with self._lock:
+            self._items.append(1)
+        try:
+            self._items.pop()
+        except Exception:   # noqa: BLE001 - drain is best-effort
+            pass

@@ -1,0 +1,9 @@
+"""Clean fixture registry (false-positive guard)."""
+
+REGISTERED_POINTS = frozenset({
+    "clean.point",
+})
+
+
+def fire(point, path=None):
+    pass

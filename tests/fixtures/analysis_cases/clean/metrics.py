@@ -1,0 +1,7 @@
+"""Clean fixture metric registry (false-positive guard)."""
+
+REGISTERED_METRICS = frozenset({
+    "dl4j_train_clean_total",
+})
+
+DERIVED_METRICS = frozenset()

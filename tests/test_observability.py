@@ -8,7 +8,6 @@ drop a request), TelemetryListener, dashboard telemetry lines, and
 ProfilerListener double-stop hardening."""
 
 import json
-import re
 import threading
 import time
 
@@ -208,51 +207,31 @@ def test_concurrent_emission_exact_totals():
 
 # ===================================================== metric-name pin
 def test_metric_registry_matches_emission_sites_and_tests():
-    """Satellite pin (the REGISTERED_POINTS discipline applied to
-    metric names): every emission call site in the package uses a
-    registered literal name, every registered name (minus the
-    registry-derived ones) has an emission site, every "dl4j_*" literal
-    anywhere in the package refers to a registered name, and every
+    """Satellite pin, PR 8 form: the hand-written regex scan is
+    replaced by the dl4j-analyze conformance pass (one source of truth
+    with tools/analyze.py and tier-1's test_static_analysis): every
+    emission site registered, every registered non-derived name
+    emitted, every telemetry-domain literal resolvable, every
     registered name appears in at least one test."""
     import pathlib
 
     import deeplearning4j_tpu
+    from deeplearning4j_tpu.analysis import analyze
 
     pkg = pathlib.Path(deeplearning4j_tpu.__file__).parent
-    emitted, referenced = set(), set()
-    emit_re = re.compile(
-        r'(?:count|observe|set_gauge|gauge_fn)\(\s*"(dl4j_[a-z0-9_]+)"')
-    fused_re = re.compile(
-        r'count_observe\(\s*"(dl4j_[a-z0-9_]+)",\s*"(dl4j_[a-z0-9_]+)"')
-    for p in pkg.rglob("*.py"):
-        src = p.read_text()
-        referenced |= set(re.findall(r'"(dl4j_[a-z0-9_]+)"', src))
-        if p.name == "metrics.py" and "observability" in str(p):
-            continue   # the registry definition itself is not a site
-        emitted |= set(emit_re.findall(src))
-        for a, b in fused_re.findall(src):
-            emitted |= {a, b}
-    extra = sorted(emitted - set(REGISTERED_METRICS))
-    unemitted = sorted(
-        set(REGISTERED_METRICS) - set(DERIVED_METRICS) - emitted)
-    assert emitted == set(REGISTERED_METRICS) - set(DERIVED_METRICS), (
-        "emission sites and REGISTERED_METRICS disagree: "
-        f"only-at-sites={extra} unemitted={unemitted}")
-    # any literal in a telemetry domain must be a registered name or a
-    # registered-name prefix (dashboard startswith filters); literals
-    # in other dl4j_ namespaces (e.g. w2v kernel labels) are not metrics
-    domains = re.compile(
-        r"dl4j_(train|serving|checkpoint|cluster|retry|breaker|jit|obs"
-        r"|perf)_")
-    unknown = {n for n in referenced
-               if domains.match(n) and n not in REGISTERED_METRICS
-               and not any(m.startswith(n) for m in REGISTERED_METRICS)}
-    assert not unknown, f"unregistered metric literals: {sorted(unknown)}"
-
-    tests_dir = pathlib.Path(__file__).parent
-    blob = "\n".join(p.read_text() for p in tests_dir.rglob("*.py"))
-    untested = sorted(m for m in REGISTERED_METRICS if m not in blob)
-    assert not untested, f"metrics with no test naming them: {untested}"
+    res = analyze(pkg, root=pkg.parent,
+                  tests_dir=pathlib.Path(__file__).parent,
+                  passes=("conformance",))
+    bad = [f for f in res.findings
+           if f.rule in ("reg-unregistered-metric",
+                         "reg-unemitted-metric")
+           or (f.rule == "reg-untested-registry-name"
+               and "metric" in f.message)]
+    assert not bad, "metric conformance: " + "; ".join(
+        f.render() for f in bad)
+    # the DERIVED_METRICS carve-out stays honest: derived names are
+    # registered but need no call site
+    assert set(DERIVED_METRICS) <= set(REGISTERED_METRICS)
 
 
 def test_registered_metrics_cover_required_names():

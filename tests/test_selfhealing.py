@@ -6,7 +6,6 @@ all-points chaos proof, orbax tree-manifest integrity parity, the
 fault-point registry pin, and ParallelInference `warmup_inputs`."""
 
 import os
-import re
 import signal
 
 import numpy as np
@@ -580,32 +579,31 @@ def test_local_sgd_default_guard_granularity_unchanged(tmp_path):
 
 # ================================================= fault-point registry
 def test_fault_point_registry_matches_source_and_tests():
-    """Satellite: every fire(...) site in the package uses a registered
-    name, every registered name has a fire site, and every registered
-    point is exercised (named) by at least one test — so a new fault
-    point cannot land silently untested."""
+    """Satellite (PR 8): the hand-written regex scan is replaced by the
+    dl4j-analyze conformance pass — tools/analyze.py, tier-1's
+    test_static_analysis, and this pin now share ONE source of truth
+    for "every fire(...) site registered, every registered point fired
+    and named by a test"."""
     import pathlib
 
     import deeplearning4j_tpu
+    from deeplearning4j_tpu.analysis import analyze
 
     pkg = pathlib.Path(deeplearning4j_tpu.__file__).parent
-    fired = set()
-    for p in pkg.rglob("*.py"):
-        fired |= set(re.findall(r'fire\(\s*"([a-z_.]+)"', p.read_text()))
-    assert fired == set(REGISTERED_POINTS), (
-        f"source fire() sites and REGISTERED_POINTS disagree: "
-        f"only-in-source={sorted(fired - REGISTERED_POINTS)} "
-        f"only-in-registry={sorted(REGISTERED_POINTS - fired)}")
-
-    tests_dir = pathlib.Path(__file__).parent
-    blob = "\n".join(p.read_text() for p in tests_dir.rglob("*.py"))
-    untested = sorted(pt for pt in REGISTERED_POINTS if pt not in blob)
-    assert not untested, f"fault points with no test naming them: " \
-                         f"{untested}"
+    res = analyze(pkg, root=pkg.parent,
+                  tests_dir=pathlib.Path(__file__).parent,
+                  passes=("conformance",))
+    bad = [f for f in res.findings
+           if f.rule in ("reg-unregistered-fault-point",
+                         "reg-unfired-fault-point")
+           or (f.rule == "reg-untested-registry-name"
+               and "fault point" in f.message)]
+    assert not bad, "fault-point conformance: " + "; ".join(
+        f.render() for f in bad)
 
     # PR 4 pins: the cluster-supervision fault domains are registered
     # (a regression dropping them from the registry or their fire sites
-    # fails the set equality above; this names them explicitly)
+    # fails the conformance pass above; this names them explicitly)
     assert {"dist.heartbeat_stale", "train.hang_hard"} \
         <= set(REGISTERED_POINTS)
     # PR 5 pin: telemetry emission rides its own fault domain —

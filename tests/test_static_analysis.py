@@ -1,0 +1,372 @@
+"""dl4j-analyze: the analyzer analyzed.
+
+Tier-1 wiring for the static suite (the shipped tree must be clean vs
+tools/analyze_baseline.json), true-positive fixtures per rule,
+false-positive guards, baseline round-trip, pragma suppression, the
+zero-jax CLI contract, and the runtime LockOrderSanitizer drills —
+including a real A->B / B->A cycle across two threads.
+"""
+
+import json
+import runpy
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from deeplearning4j_tpu.analysis import (
+    RULES,
+    Baseline,
+    LockOrderSanitizer,
+    analyze,
+)
+from deeplearning4j_tpu.analysis import sanitizers
+from deeplearning4j_tpu.analysis.concurrency_lint import (
+    run_with_catalog,
+)
+from deeplearning4j_tpu.analysis.source import load_sources
+
+pytestmark = pytest.mark.analysis
+
+ROOT = Path(__file__).resolve().parent.parent
+PKG = ROOT / "deeplearning4j_tpu"
+TESTS = ROOT / "tests"
+BASELINE = ROOT / "tools" / "analyze_baseline.json"
+BAD = TESTS / "fixtures" / "analysis_cases" / "bad"
+CLEAN = TESTS / "fixtures" / "analysis_cases" / "clean"
+
+
+# ==================================================== rule catalog
+def test_rule_catalog_covers_three_passes():
+    by_pass = {}
+    for r in RULES.values():
+        by_pass.setdefault(r.pass_name, []).append(r.id)
+        assert r.description
+    static_rules = sum(len(v) for k, v in by_pass.items()
+                       if k != "runtime")
+    assert static_rules >= 8, by_pass
+    assert set(by_pass) == {"jit", "concurrency", "conformance",
+                            "runtime"}
+    # the runtime sanitizer rules ride the same catalog
+    assert "san-lock-order-cycle" in RULES
+    assert "san-long-held-lock" in RULES
+
+
+# ============================================== tier-1: tree is clean
+def test_shipped_tree_clean_vs_baseline():
+    """THE tier-1 gate: a new violation anywhere in the package fails
+    this test with the same file:line report the CLI prints."""
+    baseline = Baseline.load(BASELINE)
+    res = analyze(PKG, root=ROOT, tests_dir=TESTS, baseline=baseline)
+    assert res.clean, "new dl4j-analyze findings:\n" + "\n".join(
+        f.render() for f in res.new)
+    # the baseline may only shrink through an explicit edit: a stale
+    # entry means a violation was fixed but left suppressed
+    assert not res.stale, (
+        "stale baseline entries (fixed — remove from "
+        "tools/analyze_baseline.json): "
+        + ", ".join(f"{e['rule']}@{e['file']}" for e in res.stale))
+    assert res.files_scanned > 100
+
+
+# ==================================================== true positives
+EXPECTED_BAD = {
+    "jit-host-sync": "bad_jit.py",
+    "jit-missing-donate": "bad_jit.py",
+    "jit-traced-python-scalar": "bad_jit.py",
+    "jit-use-after-donation": "bad_jit.py",
+    "thr-unnamed-thread": "bad_threads.py",
+    "thr-non-daemon-thread": "bad_threads.py",
+    "thr-orphan-thread": "bad_threads.py",
+    "thr-blocking-under-lock": "bad_threads.py",
+    "reg-unregistered-fault-point": "bad_registry.py",
+    "reg-unfired-fault-point": "faults.py",
+    "reg-unregistered-metric": "bad_registry.py",
+    "reg-unemitted-metric": "metrics.py",
+    "reg-swallowed-exception": "bad_registry.py",
+}
+
+
+def _bad_findings():
+    return analyze(BAD, root=ROOT, tests_dir=None).findings
+
+
+@pytest.mark.parametrize("rule,expect_file",
+                         sorted(EXPECTED_BAD.items()))
+def test_bad_fixture_true_positive(rule, expect_file):
+    hits = [f for f in _bad_findings() if f.rule == rule]
+    assert hits, f"rule {rule} found nothing in the bad fixtures"
+    assert any(f.file.endswith(expect_file) for f in hits), \
+        [f.render() for f in hits]
+    for f in hits:
+        assert f.line > 0 and f.message
+
+
+def test_bad_fixture_exact_shape():
+    """Pin the full bad-fixture report: every finding accounted for,
+    no rule fires anywhere unexpected (over-match guard)."""
+    finds = _bad_findings()
+    got = {(f.rule, f.file.rsplit("/", 1)[-1]) for f in finds}
+    assert got == {(r, f) for r, f in EXPECTED_BAD.items()}, got
+    # the two traced-scalar shapes (x.shape[i], len()) both fire
+    assert sum(1 for f in finds
+               if f.rule == "jit-traced-python-scalar") == 2
+    # the reachability guard: cold_helper's .item() is NOT flagged
+    assert not any(f.rule == "jit-host-sync"
+                   and f.symbol == "cold_helper" for f in finds)
+    # the annotated swallow is NOT flagged
+    assert not any(f.rule == "reg-swallowed-exception"
+                   and f.symbol == "swallow_annotated" for f in finds)
+
+
+def test_clean_fixture_no_findings():
+    res = analyze(CLEAN, root=ROOT, tests_dir=None)
+    assert res.findings == [], [f.render() for f in res.findings]
+
+
+# ============================================= baseline round-trip
+def test_baseline_round_trip(tmp_path):
+    finds = _bad_findings()
+    bl_path = tmp_path / "bl.json"
+    Baseline.from_findings(finds).save(bl_path)
+    bl = Baseline.load(bl_path)
+    res = analyze(BAD, root=ROOT, tests_dir=None, baseline=bl)
+    assert res.clean
+    assert len(res.suppressed) == len(finds)
+    assert not res.stale
+    # fingerprints are line-free: the same violation after an edit
+    # that shifts lines still matches
+    data = json.loads(bl_path.read_text())
+    assert all("fingerprint" in e for e in data["suppressions"])
+
+
+def test_baseline_reports_stale_entries():
+    finds = _bad_findings()
+    bl = Baseline.from_findings(finds)
+    bl.entries.append({"rule": "thr-unnamed-thread",
+                       "file": "deeplearning4j_tpu/ghost.py",
+                       "line": 1, "symbol": "gone",
+                       "message": "fixed long ago",
+                       "fingerprint": "0000000000000000"})
+    res = analyze(BAD, root=ROOT, tests_dir=None, baseline=bl)
+    assert res.clean
+    assert len(res.stale) == 1
+    assert res.stale[0]["fingerprint"] == "0000000000000000"
+
+
+def test_baseline_multiplicity(tmp_path):
+    """Two identical findings (same fingerprint — same rule, file,
+    symbol, message) need two baseline entries: baselining one copy
+    must not hide the second."""
+    pkg = tmp_path / "minipkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(textwrap.dedent("""\
+        import threading
+
+        def start_two():
+            threading.Thread(target=print, daemon=True).start()
+            threading.Thread(target=print, daemon=True).start()
+    """))
+    finds = analyze(pkg, root=tmp_path, tests_dir=None).findings
+    unnamed = [f for f in finds if f.rule == "thr-unnamed-thread"]
+    assert len(unnamed) == 2
+    assert unnamed[0].fingerprint() == unnamed[1].fingerprint()
+    bl = Baseline.from_findings([unnamed[0]])
+    res = analyze(pkg, root=tmp_path, tests_dir=None, baseline=bl)
+    assert any(f.rule == "thr-unnamed-thread" for f in res.new), \
+        "second identical violation hidden by a single baseline entry"
+
+
+# ================================================ pragma suppression
+def test_pragma_suppresses_rule(tmp_path):
+    pkg = tmp_path / "minipkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(textwrap.dedent("""\
+        import threading
+
+        def start():
+            # analyze: allow=thr-unnamed-thread,thr-orphan-thread — drill
+            t = threading.Thread(target=print, daemon=True)
+            t.start()
+    """))
+    res = analyze(pkg, root=tmp_path, tests_dir=None)
+    assert not any(f.rule in ("thr-unnamed-thread", "thr-orphan-thread")
+                   for f in res.findings), \
+        [f.render() for f in res.findings]
+
+
+# ======================================================== CLI contract
+def test_cli_clean_and_jax_free():
+    """`python tools/analyze.py` exits 0 on the shipped tree WITHOUT
+    importing jax (the no-jax AST-only tier-1 contract)."""
+    code = (
+        "import runpy, sys\n"
+        "sys.argv = ['analyze.py']\n"
+        "rc = 0\n"
+        "try:\n"
+        "    runpy.run_path(r'%s', run_name='__main__')\n"
+        "except SystemExit as e:\n"
+        "    rc = e.code or 0\n"
+        "assert 'jax' not in sys.modules, 'CLI imported jax'\n"
+        "sys.exit(rc)\n" % (ROOT / "tools" / "analyze.py"))
+    p = subprocess.run([sys.executable, "-c", code], cwd=ROOT,
+                       capture_output=True, text=True, timeout=120)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "0 new finding(s)" in p.stdout
+
+
+def test_cli_rules_and_diff_mode():
+    p = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "analyze.py"), "--rules"],
+        cwd=ROOT, capture_output=True, text=True, timeout=60)
+    assert p.returncode == 0
+    for rule in RULES:
+        assert rule in p.stdout
+    # --diff: either no changed files (clean exit) or a changed-file
+    # subset that is clean vs the baseline
+    p = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "analyze.py"), "--diff"],
+        cwd=ROOT, capture_output=True, text=True, timeout=120)
+    assert p.returncode == 0, p.stdout + p.stderr
+
+
+# ================================================= thread/lock catalog
+def test_concurrency_catalog():
+    sources = load_sources(BAD, ROOT)
+    _, catalog = run_with_catalog(sources)
+    assert len(catalog.threads) == 2
+    named = [t for t in catalog.threads if t.named]
+    assert named and named[0].name_literal == "bad-fire-and-forget"
+    kinds = {lk.kind for lk in catalog.locks}
+    assert kinds == {"Lock", "Condition"}
+
+
+# ========================================== runtime: LockOrderSanitizer
+@pytest.fixture()
+def _no_session_sanitizer():
+    """The drills install/uninstall their own sanitizer; under a
+    DL4J_TPU_SANITIZE=locks sweep a session-level one is already
+    patched in and must not be clobbered."""
+    if sanitizers.active_sanitizer() is not None:
+        pytest.skip("session lock sanitizer active "
+                    "(DL4J_TPU_SANITIZE=locks sweep)")
+    yield
+
+
+@pytest.mark.usefixtures("_no_session_sanitizer")
+def test_lock_order_cycle_detected_across_two_threads():
+    """The drill the acceptance criteria names: thread 1 takes A then
+    B, thread 2 takes B then A — real threads, real (proxied) locks,
+    sequential execution so the test can never deadlock — and the
+    sanitizer must report the A<->B cycle with both creation sites."""
+    san = LockOrderSanitizer(long_hold_s=30.0).install()
+    try:
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+
+        def a_then_b():
+            with lock_a:
+                with lock_b:
+                    pass
+
+        def b_then_a():
+            with lock_b:
+                with lock_a:
+                    pass
+
+        for fn, name in ((a_then_b, "drill-ab"), (b_then_a, "drill-ba")):
+            t = threading.Thread(target=fn, name=name, daemon=True)
+            t.start()
+            t.join(timeout=10.0)
+            assert not t.is_alive()
+
+        cycles = san.cycles()
+        assert cycles, f"no cycle found; edges={san.edges()}"
+        sites = {s for c in cycles for s in c}
+        assert all("test_static_analysis.py" in s for s in sites), sites
+        assert len(sites) == 2          # the two lock creation lines
+        vio = san.violations()
+        assert any(v["rule"] == "san-lock-order-cycle" for v in vio)
+        # both drill threads contributed edges
+        threads = {e.thread for e in san.edges()}
+        assert {"drill-ab", "drill-ba"} <= threads
+    finally:
+        san.uninstall()
+
+
+@pytest.mark.usefixtures("_no_session_sanitizer")
+def test_lock_order_no_false_cycle_on_consistent_order():
+    san = LockOrderSanitizer().install()
+    try:
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+        for _ in range(3):
+            with lock_a:
+                with lock_b:
+                    pass
+        assert san.cycles() == []
+        assert len(san.edges()) == 1
+    finally:
+        san.uninstall()
+
+
+@pytest.mark.usefixtures("_no_session_sanitizer")
+def test_rlock_reentry_is_not_a_self_edge():
+    san = LockOrderSanitizer().install()
+    try:
+        r = threading.RLock()
+        with r:
+            with r:                      # re-entry, no edge
+                pass
+        assert san.edges() == []
+        # and Condition round-trips through the proxied RLock
+        cond = threading.Condition()
+        with cond:
+            cond.notify_all()
+    finally:
+        san.uninstall()
+
+
+@pytest.mark.usefixtures("_no_session_sanitizer")
+def test_long_held_lock_flagged():
+    san = LockOrderSanitizer(long_hold_s=0.05).install()
+    try:
+        lk = threading.Lock()
+        with lk:
+            time.sleep(0.12)
+        holds = san.long_holds()
+        assert holds and holds[0].duration_s >= 0.05
+        assert any(v["rule"] == "san-long-held-lock"
+                   for v in san.violations())
+    finally:
+        san.uninstall()
+
+
+@pytest.mark.usefixtures("_no_session_sanitizer")
+def test_uninstall_restores_real_locks():
+    before = threading.Lock
+    san = LockOrderSanitizer().install()
+    assert threading.Lock is not before
+    san.uninstall()
+    assert threading.Lock is sanitizers._REAL_LOCK
+    assert threading.RLock is sanitizers._REAL_RLOCK
+    assert sanitizers.active_sanitizer() is None
+
+
+@pytest.mark.usefixtures("_no_session_sanitizer")
+def test_install_from_env_gating(monkeypatch):
+    monkeypatch.delenv(sanitizers.ENV_VAR, raising=False)
+    assert sanitizers.install_from_env() is None
+    monkeypatch.setenv(sanitizers.ENV_VAR, "locks")
+    san = sanitizers.install_from_env()
+    try:
+        assert san is not None
+        assert sanitizers.active_sanitizer() is san
+        # idempotent: a second call returns the same instance
+        assert sanitizers.install_from_env() is san
+    finally:
+        san.uninstall()

@@ -1,0 +1,40 @@
+#!/usr/bin/env python3
+"""dl4j-analyze CLI — static invariant checker for deeplearning4j_tpu.
+
+Zero-dependency: loads ONLY deeplearning4j_tpu/analysis/* (stdlib +
+ast), never the package __init__ (which would pull in jax). The
+analyzed code is parsed, not imported, so this runs in under a second
+in a bare interpreter — fast enough for a pre-commit hook:
+
+    python tools/analyze.py            # whole tree vs the baseline
+    python tools/analyze.py --diff     # only files changed vs HEAD
+    python tools/analyze.py --rules    # rule catalog
+    python tools/analyze.py --catalog  # thread/lock census
+
+Exit codes: 0 clean (vs tools/analyze_baseline.json), 1 new findings,
+2 usage error.
+"""
+
+import sys
+import types
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+def _load_analysis_package():
+    """Import deeplearning4j_tpu.analysis WITHOUT executing the heavy
+    package __init__: register a stub parent whose __path__ points at
+    the real directory, then import the subpackage normally."""
+    if "deeplearning4j_tpu" not in sys.modules:
+        stub = types.ModuleType("deeplearning4j_tpu")
+        stub.__path__ = [str(ROOT / "deeplearning4j_tpu")]
+        sys.modules["deeplearning4j_tpu"] = stub
+    from deeplearning4j_tpu.analysis import runner
+    return runner
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, str(ROOT))
+    runner = _load_analysis_package()
+    sys.exit(runner.main())
