@@ -240,6 +240,64 @@ def bench_lenet(batch=4096, iters=40):
             [d / iters * 1e3 for d in dts])
 
 
+def bench_engine(k=8, iters=512, batch=256, n_in=64, n_out=10):
+    """Engine dispatch amortization: the StepProgram's k-step lax.scan
+    group (ONE dispatch per k steps) vs k=1 per-step dispatch, same
+    net, same data stream, same rng chain (engine/step_program.py).
+    Dispatch-bound regime by design: a small MLP where per-dispatch
+    overhead dominates device compute, so the amortization is the
+    signal, not the noise. Run with `python bench.py engine [k]`;
+    `k=1` emits the ungrouped baseline (the perf_gate pair quoted in
+    PERF.md compares the two artifacts)."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu import (
+        MultiLayerNetwork,
+        NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.engine import StepProgram
+    from deeplearning4j_tpu.nn.conf import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    conf = (NeuralNetConfiguration.Builder().seed(7).updater("adam")
+            .learning_rate(1e-3).activation("relu")
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=128))
+            .layer(OutputLayer(n_out=n_out, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(n_in)).build())
+    net = MultiLayerNetwork(conf).init()
+    program = StepProgram(net)
+    rng = np.random.default_rng(0)
+    import jax
+
+    x = jax.device_put(jnp.asarray(
+        rng.normal(size=(batch, n_in)).astype(np.float32)))
+    y = jax.device_put(jnp.asarray(
+        np.eye(n_out, dtype=np.float32)[rng.integers(0, n_out, batch)]))
+    _ = float(jnp.sum(x[0]))
+    assert iters % k == 0
+    if k > 1:
+        xs = jnp.broadcast_to(x, (k,) + x.shape)
+        ys = jnp.broadcast_to(y, (k,) + y.shape)
+        program.run_group(xs, ys)          # warmup/compile
+        run_once = lambda: program.run_group(xs, ys)
+    else:
+        program.run(x, y)                  # warmup/compile
+        run_once = lambda: program.run(x, y)
+    _ = float(net._score)
+    dts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters // k):
+            run_once()
+        final_loss = float(net._score)   # host fetch: true barrier
+        dts.append(time.perf_counter() - t0)
+    assert np.isfinite(final_loss)
+    dt = min(dts)
+    return (batch * iters / dt, dt / iters, final_loss,
+            [d / iters * 1e3 for d in dts])
+
+
 def bench_word2vec(vocab=5000, n_words=2_000_000, dim=128, window=5,
                    k_neg=5, epochs=5):
     """Secondary benchmark: Word2Vec skip-gram + negative sampling
@@ -340,6 +398,25 @@ def main():
     import jax
 
     dev = jax.devices()[0]
+    if len(sys.argv) > 1 and sys.argv[1] == "engine":
+        ek = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+        ips, step_s, loss, step_ms = bench_engine(k=ek)
+        print(json.dumps({
+            "metric": "engine_step_program_examples_per_sec",
+            "value": round(ips, 1),
+            "unit": "examples/sec",
+            "vs_baseline": 1.0,
+            "steps_per_dispatch": ek,
+            "step_time_ms": round(step_s * 1e3, 3),
+            "step_ms_spread": _spread(step_ms),
+            "final_loss": round(loss, 3),
+            "config": f"mlp 64-128-10 batch=256 adam k={ek} "
+                      "(dispatch-bound regime)",
+            "device": str(dev.device_kind),
+            "platform": str(dev.platform),
+            "jax": jax.__version__,
+        }))
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "word2vec":
         wps, dt, dts = bench_word2vec()
         print(json.dumps({
@@ -440,6 +517,11 @@ def main():
         "vs_baseline": round(vs, 3),
         "step_time_ms": round(step_s * 1e3, 1),
         "step_ms_spread": _spread(step_ms),
+        # the flagship groups `unroll` steps into one compiled dispatch
+        # (bench_resnet50's k_steps_fn — the engine StepProgram's
+        # k-group role); recorded so rounds are comparable on dispatch
+        # amortization, not just throughput
+        "steps_per_dispatch": 4,
         "approx_mfu": round(mfu, 3),
         "mfu_cost_model": (None if mfu_cm is None
                            else round(mfu_cm, 3)),

@@ -24,3 +24,10 @@ from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: F401
 
 from deeplearning4j_tpu.nn.conf import ComputationGraphConfiguration  # noqa: F401,E402
 from deeplearning4j_tpu.nn.graph import ComputationGraph  # noqa: F401,E402
+
+# the training engine (PR 9): ONE compiled step + ONE host supervisor
+# shared by every fit entry point
+from deeplearning4j_tpu.engine import (  # noqa: F401,E402
+    StepHarness,
+    StepProgram,
+)

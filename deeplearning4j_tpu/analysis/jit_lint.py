@@ -10,10 +10,22 @@ thread bodies) and flags the hazards that erase compiled-path wins:
   jit-traced-python-scalar shape-derived value fed to a traced arg
   jit-use-after-donation   donated buffer read after the donating call
 
-Reachability is name-based and deliberately over-approximate: an edge
-`f -> g` exists when `f`'s body calls *any* function named `g`. False
-reachability costs a pragma; a missed hot function costs a recompile
-nobody traced.
+Reachability is a real call graph where the AST can prove one and a
+name-based over-approximation where it cannot (ROADMAP carried-forward
+gap, closed by the engine's stable entry points):
+
+  - roots: the `fit`/`output`/`predict`/HTTP-handler names, every
+    `threading.Thread` target, and the engine's StepProgram/StepHarness
+    entry points by exact qualname (`ROOT_QUALNAMES`) — the compiled
+    step path hangs off those whatever the surrounding loop is named;
+  - `self.m()` edges resolve through a class-hierarchy map (the class,
+    its ancestors, and its descendants by base-name linking — virtual
+    dispatch included) to the actual method bodies;
+  - everything else falls back to the old rule: an edge `f -> g`
+    exists when `f`'s body calls *any* function named `g`. False
+    reachability costs a pragma; a missed hot function costs a
+    recompile nobody traced — so unresolvable calls stay
+    over-approximate, never dropped.
 """
 
 from __future__ import annotations
@@ -36,6 +48,21 @@ from deeplearning4j_tpu.analysis.source import (
 # entry points of the step/serving hot paths (thread targets are added
 # dynamically — every Thread body is a hot path in this codebase)
 ROOT_NAMES = {"fit", "output", "predict", "do_POST", "do_GET"}
+
+# the engine's stable compiled-step entry points, rooted by exact
+# qualname: every fit loop now funnels through these, so the walk no
+# longer depends on what the surrounding loop method happens to be
+# called (ROADMAP: "real call-graph edges once a StepProgram
+# abstraction gives it stable entry points")
+ROOT_QUALNAMES = {
+    "deeplearning4j_tpu/engine/step_program.py::StepProgram.run",
+    "deeplearning4j_tpu/engine/step_program.py::StepProgram.run_batch",
+    "deeplearning4j_tpu/engine/step_program.py::StepProgram.run_group",
+    "deeplearning4j_tpu/engine/harness.py::StepHarness.guarded",
+    "deeplearning4j_tpu/engine/harness.py::StepHarness.step_scope",
+    "deeplearning4j_tpu/engine/harness.py::StepHarness.session",
+    "deeplearning4j_tpu/engine/harness.py::StepHarness.check_preemption",
+}
 
 STEP_SHAPED = re.compile(r"step|update|slab")
 
@@ -61,6 +88,8 @@ class _FuncInfo:
     node: ast.FunctionDef
     qualname: str
     calls: Set[str] = field(default_factory=set)
+    self_calls: Set[str] = field(default_factory=set)
+    owner_class: Optional[str] = None
     thread_targets: Set[str] = field(default_factory=set)
 
 
@@ -156,19 +185,101 @@ def collect_jit_sites(sources: List[SourceFile]) -> List[JitSite]:
 
 
 # ------------------------------------------------------- reachability
+def _is_self_call(node: ast.Call) -> bool:
+    return (isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "self")
+
+
+class _ClassGraph:
+    """Class-hierarchy map for real `self.m()` edge resolution.
+
+    Classes link by base NAME across the whole package (no imports are
+    executed), so `self.m()` resolves to the method bodies of the
+    class, its ancestors, and its descendants — virtual dispatch over
+    overrides included. Name collisions merge conservatively (both
+    hierarchies are related)."""
+
+    def __init__(self, sources: List[SourceFile]):
+        # class name -> [{bases, methods{name: node-qualname}}]
+        self.entries: Dict[str, List[dict]] = {}
+        self.derived: Dict[str, Set[str]] = {}
+        for sf in sources:
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                bases = [dotted(b).split(".")[-1] for b in node.bases]
+                methods = {
+                    ch.name: f"{sf.rel}::{sf.qualname_of(ch)}"
+                    for ch in node.body
+                    if isinstance(ch, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))}
+                self.entries.setdefault(node.name, []).append(
+                    {"bases": [b for b in bases if b],
+                     "methods": methods})
+                for b in bases:
+                    if b:
+                        self.derived.setdefault(b, set()).add(node.name)
+
+    def related(self, cls: str) -> Set[str]:
+        """The class plus ancestors and descendants by name-linking."""
+        out: Set[str] = set()
+        frontier = [cls]
+        while frontier:      # ancestors
+            c = frontier.pop()
+            if c in out:
+                continue
+            out.add(c)
+            for entry in self.entries.get(c, ()):
+                frontier.extend(entry["bases"])
+        frontier = [cls]
+        down: Set[str] = set()
+        while frontier:      # descendants
+            c = frontier.pop()
+            if c in down:
+                continue
+            down.add(c)
+            frontier.extend(self.derived.get(c, ()))
+        return out | down
+
+    def resolve(self, cls: str, method: str) -> List[str]:
+        """Qualnames of every `method` body `self.method()` can reach
+        from `cls` (empty when the hierarchy defines none — the caller
+        falls back to name matching)."""
+        return [entry["methods"][method]
+                for c in self.related(cls)
+                for entry in self.entries.get(c, ())
+                if method in entry["methods"]]
+
+
 def build_reachable(sources: List[SourceFile]) -> Set[str]:
     """Set of function qualnames reachable from the hot-path roots."""
     funcs: List[_FuncInfo] = []
     by_name: Dict[str, List[_FuncInfo]] = {}
+    by_qual: Dict[str, _FuncInfo] = {}
+    classes = _ClassGraph(sources)
     for sf in sources:
+        # AST parents of each function: methods are direct ClassDef
+        # children (nested `outer.inner` functions are NOT methods)
+        method_owner: Dict[int, str] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                for ch in node.body:
+                    if isinstance(ch, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                        method_owner[id(ch)] = node.name
         for node in sf.functions():
-            fi = _FuncInfo(sf, node, f"{sf.rel}::{sf.qualname_of(node)}")
+            fi = _FuncInfo(sf, node, f"{sf.rel}::{sf.qualname_of(node)}",
+                           owner_class=method_owner.get(id(node)))
             for sub in ast.walk(node):
                 if isinstance(sub, ast.Call):
                     n = call_name(sub)
                     if n:
-                        fi.calls.add(n)
-                    if call_name(sub) == "Thread":
+                        if _is_self_call(sub):
+                            fi.self_calls.add(n)
+                        else:
+                            fi.calls.add(n)
+                    if n == "Thread":
                         for kw in sub.keywords:
                             if kw.arg == "target":
                                 tn = dotted(kw.value).split(".")[-1]
@@ -176,13 +287,15 @@ def build_reachable(sources: List[SourceFile]) -> Set[str]:
                                     fi.thread_targets.add(tn)
             funcs.append(fi)
             by_name.setdefault(node.name, []).append(fi)
+            by_qual[fi.qualname] = fi
 
     thread_roots: Set[str] = set()
     for fi in funcs:
         thread_roots |= fi.thread_targets
     roots = [fi for fi in funcs
              if fi.node.name in ROOT_NAMES
-             or fi.node.name in thread_roots]
+             or fi.node.name in thread_roots
+             or fi.qualname in ROOT_QUALNAMES]
 
     seen: Set[str] = set()
     frontier = list(roots)
@@ -191,6 +304,20 @@ def build_reachable(sources: List[SourceFile]) -> Set[str]:
         if fi.qualname in seen:
             continue
         seen.add(fi.qualname)
+        # real edges: self.m() through the class hierarchy when it
+        # resolves; name-based fallback when it does not
+        for called in fi.self_calls:
+            targets = (classes.resolve(fi.owner_class, called)
+                       if fi.owner_class else [])
+            if targets:
+                for q in targets:
+                    callee = by_qual.get(q)
+                    if callee is not None and callee.qualname not in seen:
+                        frontier.append(callee)
+                continue
+            for callee in by_name.get(called, ()):
+                if callee.qualname not in seen:
+                    frontier.append(callee)
         for called in fi.calls | fi.thread_targets:
             for callee in by_name.get(called, ()):
                 if callee.qualname not in seen:

@@ -40,62 +40,36 @@ class EarlyStoppingTrainer:
 
             self._snapshotter = PeriodicSnapshotter(
                 guard, every=snapshot_every)
+        from deeplearning4j_tpu.engine import StepHarness
+
         self.config = config
         self.net = net
         self.train_iterator = train_iterator
-        self.guard = guard
-        self._guard_batches = 0
+        # the shared supervisor (engine/): one guard-verdict dispatch
+        # for all three fit entry points; this trainer's rollback
+        # target is the in-memory snapshotter
+        self._harness = StepHarness(net, guard=guard,
+                                    snapshotter=self._snapshotter)
+        self.guard = self._harness.guard
 
     def _fit_batch(self, batch):
-        """One training batch; EarlyStoppingParallelTrainer overrides to
-        route through ParallelWrapper. Uses fit_batch so the net's epoch
+        """One training batch through the shared StepProgram (full
+        fit_batch semantics — listener fire, TBPTT/solver fallback);
+        EarlyStoppingParallelTrainer overrides to route through
+        ParallelWrapper. Uses the fit_batch path so the net's epoch
         counter stays under THIS trainer's control."""
-        self.net.fit_batch(batch)
+        self._harness.program.run_batch(batch)
 
     def _fit_batch_guarded(self, batch) -> bool:
-        """Run one batch under the guard; False = batch rejected (state
+        """Run one batch under the shared harness's guard dispatch
+        (engine.StepHarness.guarded); False = batch rejected (state
         restored), so the caller skips score/termination checks."""
-        from deeplearning4j_tpu.resilience.errors import (
-            NonFiniteLossError,
-        )
-
-        g = self.guard
-        if g is None:
+        if self.guard is None:
             self._fit_batch(batch)
             return True
-        check = g.should_check(self._guard_batches)
-        self._guard_batches += 1
-        if self._snapshotter is not None:
-            self._snapshotter.maybe_snapshot(self.net)
-        snap = (g.snapshot(self.net)
-                if check and g.policy == "skip_step" else None)
-        self._fit_batch(batch)
-        if not check:
-            return True
-        verdict = g.post_step(self.net)
-        if verdict == "ok":
-            return True
-        if g.policy == "skip_step":
-            g.restore(self.net, snap)
-            g.note_skip()
-            logger.warning("early stopping: %s batch at epoch %d "
-                           "skipped, state restored", verdict,
-                           self.net.epoch)
-            return False
-        if g.policy == "rollback":
-            g.note_rollback()
-            if g.counters["rollbacks"] > g.max_rollbacks:
-                raise NonFiniteLossError(
-                    f"guard exceeded max_rollbacks={g.max_rollbacks} "
-                    f"at epoch {self.net.epoch}")
-            self._snapshotter.restore(self.net)
-            logger.warning("early stopping: %s batch at epoch %d — "
-                           "rolled back to in-memory snapshot",
-                           verdict, self.net.epoch)
-            return False
-        raise NonFiniteLossError(
-            f"{verdict} training state at epoch {self.net.epoch} "
-            "(policy=abort)")
+        return self._harness.guarded(
+            lambda: self._fit_batch(batch),
+            context=f"at epoch {self.net.epoch}", observe=False)
 
     def _on_epoch_data_end(self):
         """Hook after the epoch's batch loop (parallel trainer flushes
@@ -116,6 +90,31 @@ class EarlyStoppingTrainer:
         reason = None
         details = ""
 
+        # shared session lifecycle: flush + close the train iterator's
+        # prefetch thread (AsyncDataSetIterator.close) even when a
+        # termination condition or the guard aborts the fit
+        self._harness.attach_data(self.train_iterator)
+        with self._harness.session():
+            reason, details, best_score, best_epoch, epoch = \
+                self._fit_epochs(cfg, net, score_vs_epoch, best_score,
+                                 best_epoch, epoch, reason, details)
+
+        logger.info("Early stopping: %s (%s); best epoch %d score %s",
+                    reason, details, best_epoch, best_score)
+        best_model = cfg.model_saver.get_best_model(like_net=net)
+        return EarlyStoppingResult(
+            termination_reason=reason,
+            termination_details=details,
+            score_vs_epoch=score_vs_epoch,
+            best_model_epoch=best_epoch,
+            best_model_score=(float("nan") if best_score is None
+                              else best_score),
+            total_epochs=epoch,
+            best_model=best_model,
+        )
+
+    def _fit_epochs(self, cfg, net, score_vs_epoch, best_score,
+                    best_epoch, epoch, reason, details):
         while reason is None:
             net.epoch = epoch
             if hasattr(self.train_iterator, "reset"):
@@ -158,17 +157,4 @@ class EarlyStoppingTrainer:
                         details = f"{type(c).__name__} at epoch {epoch}"
                         break
             epoch += 1
-
-        logger.info("Early stopping: %s (%s); best epoch %d score %s",
-                    reason, details, best_epoch, best_score)
-        best_model = cfg.model_saver.get_best_model(like_net=net)
-        return EarlyStoppingResult(
-            termination_reason=reason,
-            termination_details=details,
-            score_vs_epoch=score_vs_epoch,
-            best_model_epoch=best_epoch,
-            best_model_score=(float("nan") if best_score is None
-                              else best_score),
-            total_epochs=epoch,
-            best_model=best_model,
-        )
+        return reason, details, best_score, best_epoch, epoch

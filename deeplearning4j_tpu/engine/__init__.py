@@ -1,0 +1,43 @@
+"""Training engine: ONE compiled step program + ONE host supervisor.
+
+ROADMAP item 1. Before this package, TrainingMaster.fit,
+ParallelWrapper._run_guarded, and EarlyStoppingTrainer each re-wired
+the same concerns (non-finite guard, watchdog, preemption, checkpoint
+publish, telemetry accumulator, phase profiler) around three separate
+step loops — so every compiled-path change (MFU work, pjit sharding)
+had to land three times. Tensor Processing Primitives (arXiv
+2104.05755) argues for exactly this separation: a small set of
+compiled primitives composed under one host-side schedule; Automatic
+Cross-Replica Sharding of Weight Update (arXiv 2004.13336) assumes a
+single step program to shard. Two halves:
+
+  StepProgram   the compiled half — a pure, jitted, donated-buffer
+                train step (params / updater state / BN states donated
+                end-to-end), owner of the shared loss/update closures
+                the local-SGD and stale-gradient trainers also compile
+                from, registered with the net's JitCache (recompile
+                forensics) and a CostModel (MFU gauges) on demand.
+                Optional `lax.scan` k-step grouping: one dispatch
+                advances k steps — the dispatch-amortization role of
+                the bench's hand-unrolled k_steps_fn, generalized —
+                while per-inner-step dp-visible losses are preserved
+                so a NonFiniteGuard can condemn ONE poisoned inner
+                step instead of the whole window.
+  StepHarness   the host half — one supervisor owning the
+                guard-verdict dispatch (skip / rollback / abort),
+                watchdog lifecycle + beats, preemption install +
+                step-boundary checks, checkpoint cadence, the
+                StepAccumulator every per-step metric batches through,
+                the StepPhaseProfiler wiring, tracer spans, and
+                teardown (flush, stop, close attached data iterators).
+                TrainingMaster, ParallelWrapper, and
+                EarlyStoppingTrainer are thin adapters over it.
+"""
+
+from deeplearning4j_tpu.engine.harness import StepHarness
+from deeplearning4j_tpu.engine.step_program import (
+    StepProgram,
+    make_loss_and_apply,
+)
+
+__all__ = ["StepProgram", "StepHarness", "make_loss_and_apply"]

@@ -1,0 +1,269 @@
+"""StepProgram: the ONE compiled train step every fit loop runs on.
+
+The compiled half of the engine (see package docstring). A StepProgram
+wraps a container net (MultiLayerNetwork or ComputationGraph) and owns:
+
+  - the shared loss/update closures (`make_loss_and_apply`) that the
+    single step, the k-step group, the local-SGD rendezvous trainer,
+    and the stale-gradient trainer all compile from — one source of
+    step math;
+  - `run(x, y)`: one training step in the canonical (x, y, fm, lm)
+    batch shape, with the graph-input and truncated-BPTT adaptation
+    that TrainingMaster and ParallelWrapper previously each hand-rolled
+    (the compiled program is the net's own cached, donated train step —
+    byte-identical state evolution by construction);
+  - `run_group(xs, ys)`: the `lax.scan` k-step group — ONE dispatch
+    advances k steps on stacked [k, ...] data, splitting the rng chain
+    exactly as k sequential steps would, donating params / updater
+    state / BN states end-to-end, and returning the [k] per-inner-step
+    losses (`last_step_losses`) so a NonFiniteGuard can condemn a
+    single poisoned inner step instead of the whole window. This
+    generalizes the local-SGD grouping (which adds a dp rendezvous on
+    top) and the bench's hand-unrolled k_steps_fn (dispatch
+    amortization, PERF.md);
+  - perf registration: the group program lands in the net's JitCache
+    (key `("engine_group", ...)`, `record_trace` inside the traced
+    body) so recompile forensics cover it, and `register_perf`
+    attaches an XLA cost-analysis entry to a CostModel so MFU gauges
+    and the forensics cost digest follow automatically.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def make_loss_and_apply(net):
+    """(loss_for_grad, apply_updates) closures over a net — the shared
+    step math. Every compiled step variant (StepProgram single/group,
+    LocalStepTrainer's dp rendezvous, StaleGradientTrainer) builds from
+    these two closures, so a change to the step lands once.
+
+    `loss_for_grad(params, states, x, y, rng, fm, lm)` returns
+    (loss, new_states) with the net's mixed-precision policy applied
+    (bf16 compute params/inputs, f32 master params and loss).
+    `apply_updates(params, upd_states, grads, lr, step)` runs the fused
+    per-layer updater chain with per-layer lr factors and frozen flags
+    baked in (callers must key compiled-program caches on the frozen
+    signature)."""
+    import jax
+
+    conf = net.conf
+    cd = net.compute_dtype
+    is_graph = hasattr(conf, "network_inputs")
+
+    def loss_for_grad(params, states, x, y, rng, fm, lm):
+        if cd is not None:
+            from deeplearning4j_tpu.nn.dtype import cast_floating
+            params = cast_floating(params, cd)
+            x = cast_floating(x, cd)
+        loss, (new_states, _) = net._loss_fn(
+            params, states, x, y, rng, fm, lm, rnn_carries=None)
+        if cd is not None:
+            loss = loss.astype(net.dtype)
+        return loss, new_states
+
+    if is_graph:
+        layer_names = [n.name for n in net.topo if n.kind == "layer"]
+        frozen = {n.name for n in net.topo
+                  if n.kind == "layer" and n.obj.frozen}
+        lr_factors = {
+            n.name: ((n.obj.learning_rate / conf.learning_rate)
+                     if getattr(n.obj, "learning_rate", None) is not None
+                     and conf.learning_rate != 0 else 1.0)
+            for n in net.topo if n.kind == "layer"}
+
+        def apply_updates(params, upd_states, grads, lr, step):
+            from deeplearning4j_tpu.nn.updater import fused_apply
+            np_list, nu_list = fused_apply(
+                [(net._updaters[name], lr_factors[name], name in frozen,
+                  params[name], grads[name], upd_states[name])
+                 for name in layer_names], lr, step)
+            return (dict(zip(layer_names, np_list)),
+                    dict(zip(layer_names, nu_list)))
+    else:
+        lr_factors = [
+            (l.learning_rate / conf.learning_rate)
+            if l.learning_rate is not None and conf.learning_rate != 0
+            else 1.0 for l in conf.layers]
+
+        def apply_updates(params, upd_states, grads, lr, step):
+            from deeplearning4j_tpu.nn.updater import fused_apply
+            return fused_apply(
+                [(net._updaters[i], lr_factors[i], conf.layers[i].frozen,
+                  params[i], grads[i], upd_states[i])
+                 for i in range(len(params))], lr, step)
+
+    return loss_for_grad, apply_updates
+
+
+class StepProgram:
+    """One net's compiled training step, in every grouping.
+
+    `run` / `run_batch` execute exactly one optimizer step (the net's
+    own cached donated program — the k=1 program); `run_group` executes
+    a k-step `lax.scan` group in one dispatch. All three mutate the net
+    the way a train step always has (params / updater state / BN states
+    rebound, rng split, iteration advanced, `_score` set) so guards,
+    snapshots, and checkpoints see an identical contract."""
+
+    def __init__(self, net):
+        self.net = net
+        self.is_graph = hasattr(net.conf, "network_inputs")
+        self.is_tbptt = getattr(net.conf, "backprop_type", None) \
+            == "truncated_bptt"
+        # [k] dp-visible per-inner-step losses of the newest run_group
+        # dispatch (device array; fetched by the guard only on checked
+        # groups so the hot loop never syncs)
+        self.last_step_losses = None
+
+    # ------------------------------------------------------ validation
+    def require_sgd(self, entry: str) -> None:
+        """Line-search solvers drive multiple loss evaluations per
+        iteration from the host — there is no single compiled step to
+        supervise. Every harness entry point calls this once."""
+        if getattr(self.net.conf, "optimization_algo",
+                   "stochastic_gradient_descent") not in (
+                "stochastic_gradient_descent", "sgd"):
+            raise NotImplementedError(
+                f"line-search solvers are not supported under {entry}; "
+                "use stochastic_gradient_descent")
+
+    # ------------------------------------------------------- single step
+    def _graph_args(self, x, y, fm, lm):
+        name = self.net.conf.network_inputs[0]
+        return ({name: x}, [y],
+                None if fm is None else {name: fm},
+                None if lm is None else [lm])
+
+    def run(self, x, y, fm=None, lm=None):
+        """One training step on a canonical (x, y[, fm, lm]) batch:
+        the graph-input and TBPTT-chunking dispatch the fit loops used
+        to duplicate, routed into the net's cached donated step
+        program. Returns the device loss scalar."""
+        net = self.net
+        chunked = self.is_tbptt and getattr(x, "ndim", 0) == 3
+        if self.is_graph:
+            ins, labs, fms, lms = self._graph_args(x, y, fm, lm)
+            if chunked:
+                return net._fit_tbptt(ins, labs, fms, lms)
+            loss, _ = net._train_step(ins, labs, fms, lms)
+            return loss
+        if chunked:
+            return net._fit_tbptt(x, y, fm, lm)
+        loss, _ = net._train_step(x, y, fm, lm)
+        return loss
+
+    def run_batch(self, batch):
+        """One step on a batch in any container shape ((x, y), DataSet,
+        (x, y, fm, lm), ...) with full fit_batch semantics (listener
+        fire, solver fallback) — the EarlyStoppingTrainer entry."""
+        return self.net.fit_batch(batch)
+
+    # ------------------------------------------------------ k-step group
+    def _frozen_sig(self):
+        net = self.net
+        if self.is_graph:
+            return tuple(sorted(n.name for n in net.topo
+                                if n.kind == "layer" and n.obj.frozen))
+        return tuple(i for i, l in enumerate(net.conf.layers)
+                     if l.frozen)
+
+    def _build_group(self, k: int, with_fm: bool, with_lm: bool,
+                     trace_key: str):
+        """Compile the k-step scan group. The scan carry splits the rng
+        chain per inner step exactly like k sequential `_train_step`
+        calls (`rng, sub = split(rng)`), so the group's state evolution
+        matches the sequential oracle; per-inner-step losses come back
+        stacked [k] for the guard's granularity."""
+        import jax
+
+        from deeplearning4j_tpu.nn.updater import schedule_lr
+
+        net = self.net
+        conf = net.conf
+        loss_for_grad, apply_updates = make_loss_and_apply(net)
+
+        def group_step_fn(params, upd_states, states, rng, step0,
+                          xs, ys, fms, lms, lr_scale):
+            net._jit_cache.record_trace(trace_key)
+
+            def one(carry, sl):
+                params, upd_states, states, rng, step = carry
+                x, y, fm, lm = sl
+                rng, sub = jax.random.split(rng)
+                (loss, new_states), grads = jax.value_and_grad(
+                    loss_for_grad, has_aux=True)(
+                        params, states, x, y, sub, fm, lm)
+                grads = net._clip_grads(grads)
+                lr = schedule_lr(conf, step) * lr_scale
+                params, upd_states = apply_updates(
+                    params, upd_states, grads, lr, step)
+                return ((params, upd_states, new_states, rng, step + 1),
+                        loss)
+
+            (params, upd_states, states, rng, _), losses = jax.lax.scan(
+                one, (params, upd_states, states, rng, step0),
+                (xs, ys, fms, lms))
+            return params, upd_states, states, rng, losses
+
+        return jax.jit(group_step_fn, donate_argnums=(0, 1, 2, 3))
+
+    def group_key(self, k: int, with_fm: bool, with_lm: bool):
+        """JitCache key of the k-step group program (public so perf
+        registration and forensics reads name the same entry)."""
+        return ("engine_group", k, with_fm, with_lm, self._frozen_sig())
+
+    def run_group(self, xs, ys, fms=None, lms=None):
+        """One dispatch, k steps. `xs`/`ys` (and optional masks) carry a
+        leading [k, ...] step dim; state advances exactly as k
+        sequential `run` calls would (same rng split chain, same
+        per-step lr schedule). Sets `last_step_losses` to the [k]
+        device losses and `_score` to the final one. TBPTT nets and
+        lr_policy='score' have per-step host state and fall back to
+        k=1 dispatch upstream."""
+        import jax
+        import jax.numpy as jnp
+
+        net = self.net
+        if self.is_tbptt:
+            raise NotImplementedError(
+                "k-step grouping does not support truncated BPTT (the "
+                "scan carries no RNN state); use steps_per_dispatch=1")
+        if getattr(net.conf, "lr_policy", None) == "score":
+            raise NotImplementedError(
+                "k-step grouping does not support lr_policy='score' "
+                "(the decay factor is host state updated per step); "
+                "use steps_per_dispatch=1")
+        k = int(np.asarray(xs).shape[0])
+        if self.is_graph:
+            xs, ys, fms, lms = self._graph_args(xs, ys, fms, lms)
+        key = self.group_key(k, fms is not None, lms is not None)
+        cache = net._jit_cache
+        if key not in cache:
+            cache[key] = self._build_group(
+                k, fms is not None, lms is not None, str(key))
+        (net.params, net.updater_states, net.states, net._rng,
+         losses) = cache[key](
+            net.params, net.updater_states, net.states, net._rng,
+            jnp.asarray(net.iteration, jnp.int32), xs, ys, fms, lms,
+            jnp.asarray(net._lr_score_factor, jnp.float32))
+        net.iteration += k
+        self.last_step_losses = losses
+        net._score = losses[-1]
+        return losses[-1]
+
+    # ------------------------------------------------------------- perf
+    def register_perf(self, cost_model, key=None, *example_args,
+                      analytic_flops=None, analytic_bytes=None):
+        """Attach an XLA cost-analysis entry for a compiled engine
+        program to `cost_model` (and, through it, the JitCache
+        forensics ring). `key` defaults to the net's k=1 train entry;
+        pass a `group_key(...)` to register a k-step group. Best-effort
+        like serving warmup: returns the entry dict or None."""
+        cache = self.net._jit_cache
+        if key is None:
+            key = ("train", self._frozen_sig())
+        return cost_model.register_jit_entry(
+            cache, key, *example_args, analytic_flops=analytic_flops,
+            analytic_bytes=analytic_bytes)

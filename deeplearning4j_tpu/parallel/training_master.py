@@ -81,7 +81,8 @@ class TrainingMaster:
                  supervisor: Optional[Supervisor] = None,
                  guard_inner_steps: bool = False,
                  tracer=None,
-                 phase_profiler=None):
+                 phase_profiler=None,
+                 steps_per_dispatch: int = 1):
         """`averaging_frequency=k > 1` runs k-step local SGD between
         parameter rendezvous — each dp shard trains privately for k
         steps, then params (+ updater state) are averaged. This is the
@@ -143,38 +144,63 @@ class TrainingMaster:
         # localize a poisoned INNER step instead of condemning the
         # whole k-step window
         self.guard_inner_steps = bool(guard_inner_steps)
-        self._poisoned_steps = set()
-        self._resil_counters = {"data_skipped_steps": 0,
-                                "grad_poisoned_steps": 0,
-                                "preemptions": 0}
+        # `steps_per_dispatch=k > 1` runs the engine's lax.scan k-step
+        # group on the single-program path: one dispatch advances k
+        # steps (amortizing per-dispatch RTT, PERF.md), per-inner-step
+        # losses preserved so the guard condemns ONE poisoned step.
+        # Orthogonal to averaging_frequency (which groups steps at the
+        # local-SGD rendezvous instead).
+        self.steps_per_dispatch = max(1, int(steps_per_dispatch))
+        if self.steps_per_dispatch > 1 and self.averaging_frequency > 1:
+            raise ValueError(
+                "steps_per_dispatch > 1 and averaging_frequency > 1 "
+                "are mutually exclusive groupings (the local-SGD "
+                "rendezvous already scans its k steps in one dispatch)")
         self._staged = False
         self._local_step = None
-        # observability (observability/): a Tracer records per-step
-        # spans (fetch/dispatch/sync/checkpoint) on one exportable
-        # timeline; registry metrics are always emitted (guarded,
-        # near-zero cost) regardless. The per-step counters/histograms
-        # batch through a StepAccumulator (flushed every 32 steps and
-        # at fit end) so the hot loop pays container appends, not
-        # registry locks.
-        self.tracer = tracer
-        self._step_span = None
-        self._obs_acc = _obs.StepAccumulator()
-        # step phase attribution (observability/perf.py): opt-in like
-        # the tracer; phase_profiler=True builds the default profiler.
-        # Emission rides THIS loop's StepAccumulator so the phase
-        # histograms cost container appends, not registry locks.
-        if phase_profiler is True:
-            from deeplearning4j_tpu.observability.perf import (
-                StepPhaseProfiler,
-            )
+        # ONE supervisor (engine.StepHarness) owns the guard-verdict
+        # dispatch, watchdog lifecycle, preemption checks, the
+        # StepAccumulator every per-step metric batches through
+        # (flushed every 32 steps and at fit end — container appends,
+        # not registry locks), and the opt-in phase profiler; a Tracer
+        # records per-step spans (fetch/dispatch/sync/checkpoint) on
+        # one exportable timeline.
+        from deeplearning4j_tpu.engine import StepHarness
 
-            phase_profiler = StepPhaseProfiler()
-        self.phase_profiler = phase_profiler
-        if self.phase_profiler is not None:
-            if self.phase_profiler.accumulator is None:
-                self.phase_profiler.accumulator = self._obs_acc
-            if self.phase_profiler.tracer is None:
-                self.phase_profiler.tracer = tracer
+        self._harness = StepHarness(
+            net, guard=guard, watchdog=watchdog,
+            preemption=self.preemption, supervisor=supervisor,
+            tracer=tracer, phase_profiler=phase_profiler)
+        self._obs_acc = self._harness.acc
+        self._poisoned_steps = self._harness.poisoned_steps
+        self._resil_counters = self._harness.counters
+
+    # tracer / phase_profiler delegate to the harness so post-
+    # construction assignment (bench_obs.py's config sweep) reaches
+    # the loop that actually reads them
+    @property
+    def tracer(self):
+        return self._harness.tracer
+
+    @tracer.setter
+    def tracer(self, tracer):
+        self._harness.tracer = tracer
+        pp = self._harness.phase_profiler
+        if pp is not None and pp.tracer is None:
+            pp.tracer = tracer
+
+    @property
+    def phase_profiler(self):
+        return self._harness.phase_profiler
+
+    @phase_profiler.setter
+    def phase_profiler(self, pp):
+        if pp is not None:
+            if pp.accumulator is None:
+                pp.accumulator = self._harness.acc
+            if pp.tracer is None:
+                pp.tracer = self._harness.tracer
+        self._harness.phase_profiler = pp
 
     # ------------------------------------------------------------ dist init
     @staticmethod
@@ -286,41 +312,26 @@ class TrainingMaster:
         monitor thread parents its hang events to the current step
         span."""
         self._stage_net()
-        net = self.net
         guard = self.guard
-        wd = self.watchdog
         if start_step is None:
             start_step = self.load_latest_checkpoint()
         if collect_training_stats:
             self._stats = []
-        if getattr(net.conf, "optimization_algo",
-                   "stochastic_gradient_descent") not in (
-                "stochastic_gradient_descent", "sgd"):
-            raise NotImplementedError(
-                "line-search solvers are not supported under "
-                "TrainingMaster; use stochastic_gradient_descent")
+        self._harness.program.require_sgd("TrainingMaster")
         if (guard is not None and guard.policy == "rollback"
                 and self.checkpoint_dir and not self.list_checkpoints()):
             # a rollback target must exist before the first poisoned
             # step — seed one at the fit's starting state
             self.save_checkpoint(start_step)
-        if self.preemption is not None:
-            self.preemption.install()
-        if wd is not None:
-            wd.start()
-            # hang events recorded by the monitor thread attach to the
-            # training thread's current step span (cross-thread parent)
-            wd.tracer = self.tracer
-        try:
+        with self._harness.session():
             if self.averaging_frequency > 1:
                 return self._fit_local_sgd(batch_fn, num_steps,
                                            start_step,
                                            collect_training_stats)
-            is_graph = hasattr(net.conf, "network_inputs")
-            is_tbptt = getattr(net.conf, "backprop_type", None) \
-                == "truncated_bptt"
-            tr = self.tracer
-            pp = self.phase_profiler
+            if self.steps_per_dispatch > 1:
+                return self._fit_grouped(batch_fn, num_steps,
+                                         start_step,
+                                         collect_training_stats)
             with self.mesh:
                 step = start_step
                 while step < num_steps:
@@ -328,62 +339,32 @@ class TrainingMaster:
                         step += 1   # rollback replay: skip the poisoned
                         continue    # data window, train nothing on it
                     self._check_preemption(step)
-                    step_t0 = time.perf_counter()
-                    sp = (tr.begin("train_step", cat="train",
-                                   args={"step": step})
-                          if tr is not None else None)
-                    self._step_span = sp
-                    if wd is not None:
-                        wd.trace_parent = sp
-                    if pp is not None:
-                        pp.begin_step(step)
-                    try:
+                    with self._harness.step_scope(step):
                         step = self._fit_one_step(
-                            batch_fn, step, is_graph, is_tbptt,
-                            collect_training_stats)
-                    finally:
-                        self._obs_acc.count_observe(
-                            "dl4j_train_steps_total",
-                            "dl4j_train_step_seconds",
-                            time.perf_counter() - step_t0)
-                        if pp is not None:
-                            pp.end_step()
-                        self._step_span = None
-                        if sp is not None:
-                            sp.end()
-        finally:
-            self._obs_acc.flush()
-            if wd is not None:
-                wd.stop()
-            if self.preemption is not None:
-                self.preemption.uninstall()
+                            batch_fn, step, collect_training_stats)
         return self
 
-    def _fit_one_step(self, batch_fn, step, is_graph, is_tbptt,
+    def _fit_one_step(self, batch_fn, step,
                       collect_training_stats) -> int:
-        """One attempted global step (extracted so fit() wraps it in
-        span + metric accounting): returns the step index to continue
-        from — step+1 normally and on skips, the restored step after a
-        rollback."""
+        """One attempted global step (fit() wraps it in the harness's
+        step_scope for span + metric accounting): returns the step
+        index to continue from — step+1 normally and on skips, the
+        restored step after a rollback."""
         net = self.net
         guard = self.guard
-        wd = self.watchdog
+        harness = self._harness
         tr = self.tracer
-        pp = self.phase_profiler
-        sp = self._step_span
+        sp = harness.step_span
         _fire("train.step")
         _fire("train.hang")
         fire_hang_hard()
-        if wd is not None:
-            wd.beat("dispatch", step=step)
-        if pp is not None:
-            pp.mark("data_wait")
+        harness.beat("dispatch", step=step)
+        harness.mark("data_wait")
         t0 = time.perf_counter()
         batch = self._next_batch(batch_fn, step)
         if batch is None:       # bad batch skipped by policy
             return step + 1
-        if pp is not None:
-            pp.mark("h2d")
+        harness.mark("h2d")
         x, y = self._global_batch(
             self._maybe_poison(batch[0]), batch[1])
         t1 = time.perf_counter()
@@ -396,52 +377,41 @@ class TrainingMaster:
         # a checkpoint must never publish non-finite state: force a
         # check on checkpoint steps even when the sampling cadence
         # would skip them
-        check_now = guard is not None and (
-            guard.should_check(step)
-            or (ckpt_due and guard.check_every > 0))
-        snap = (guard.snapshot(net)
-                if check_now and guard.policy == "skip_step"
-                else None)
-        chunked = is_tbptt and getattr(x, "ndim", 0) == 3
-        if pp is not None:
-            pp.mark("dispatch")
-        if is_graph:
-            name = net.conf.network_inputs[0]
-            if chunked:
-                net._fit_tbptt({name: x}, [y], None, None)
-            else:
-                net._train_step({name: x}, [y])
-        elif chunked:
-            net._fit_tbptt(x, y, None, None)
-        else:
-            net._train_step(x, y)
+        check_now = harness.should_check(step=step) \
+            or (ckpt_due and harness.should_check(force=True))
+        snap = harness.pre_step_snapshot(check_now)
+        harness.mark("dispatch")
+        harness.program.run(x, y)
         t_disp = time.perf_counter()
         if tr is not None:
             tr.record("dispatch", t1, t_disp, cat="train", parent=sp)
-        if wd is not None:
-            wd.beat("fetch", step=step)
-        if pp is not None:
-            # sampled device sync: the blocked interval on the step's
-            # loss value is the device_compute phase; everything after
-            # is host-side sync work (guard checks, score fetches)
-            pp.sync(getattr(net, "_score", None), step=step)
-            pp.mark("host_sync")
+        harness.beat("fetch", step=step)
+        # sampled device sync: the blocked interval on the step's
+        # loss value is the device_compute phase; everything after
+        # is host-side sync work (guard checks, score fetches)
+        harness.sync(getattr(net, "_score", None), step=step)
+        harness.mark("host_sync")
         if check_now:
             verdict = guard.post_step(net)
             if verdict != "ok":
-                if guard.policy == "skip_step":
-                    guard.restore(net, snap)
-                    guard.note_skip()
+                restored = {}
+
+                def _rollback_to_checkpoint():
+                    self._poisoned_steps.add(step)
+                    restored["step"] = self.load_latest_checkpoint()
                     logger.warning(
-                        "guard: %s at step %d — step "
-                        "skipped, state restored",
-                        verdict, step)
+                        "guard: rolled back to checkpoint step %d; "
+                        "step %d will be skipped on replay",
+                        restored["step"], step)
+
+                action = harness.dispatch_verdict(
+                    verdict, snap=snap,
+                    restore_rollback=_rollback_to_checkpoint,
+                    context=f"at step {step}")
+                if action == "skip":
                     return step + 1
-                if guard.policy == "rollback":
-                    return self._rollback(step, verdict)
-                raise NonFiniteLossError(
-                    f"{verdict} training state at step "
-                    f"{step} (policy=abort)")
+                if action == "rollback":
+                    return restored["step"]
         if collect_training_stats:
             # host fetch = true step barrier for honest timing
             # analyze: allow=jit-host-sync — opt-in stats mode only
@@ -452,14 +422,12 @@ class TrainingMaster:
             # span is the device+fetch-result phase made visible
             tr.record("device_sync", t_disp, t2, cat="train",
                       parent=sp)
-        if pp is not None:
-            pp.mark("telemetry")   # listener callbacks are user telemetry
+        harness.mark("telemetry")   # listener callbacks are user telemetry
         for listener in net.listeners:
             listener.iteration_done(net, net.iteration)
         t3 = time.perf_counter()
         if ckpt_due:
-            if pp is not None:
-                pp.mark("checkpoint")
+            harness.mark("checkpoint")
             self.save_checkpoint(done)
         if collect_training_stats:
             self._stats.append({
@@ -514,50 +482,165 @@ class TrainingMaster:
         return x
 
     def _check_preemption(self, step):
-        """Step-boundary preemption check: a pending SIGTERM/SIGINT (or
-        a triggered `train.preempt` fault) checkpoints the CURRENT state
-        and raises PreemptedError — a preempted job loses zero completed
-        steps and a Supervisor (or a relaunch) resumes exactly here."""
-        requested = False
-        try:
-            _fire("train.preempt")
-        except FaultInjectedError:
-            requested = True
-            if self.preemption is not None:
-                self.preemption.request(simulated=True)
-        if self.preemption is not None and self.preemption.requested:
-            requested = True
-        if not requested:
-            return
-        self._resil_counters["preemptions"] += 1
-        _obs.count("dl4j_train_preemptions_total")
-        if self.preemption is not None:
-            self.preemption.counters["preemptions"] += 1
-            self.preemption.clear()   # a supervised restart may resume
-        if self.checkpoint_dir:
-            self.save_checkpoint(step)
-        raise PreemptedError(
-            f"preempted at step {step}"
-            + ("; checkpoint saved" if self.checkpoint_dir else ""),
-            step=step)
+        """Step-boundary preemption check (engine.StepHarness owns the
+        logic): a pending SIGTERM/SIGINT or a triggered `train.preempt`
+        fault checkpoints the CURRENT state and raises PreemptedError —
+        a preempted job loses zero completed steps and a Supervisor (or
+        a relaunch) resumes exactly here."""
+        self._harness.check_preemption(
+            step, save_checkpoint=(self.save_checkpoint
+                                   if self.checkpoint_dir else None))
 
-    def _rollback(self, poisoned_step, verdict) -> int:
-        """Guard policy 'rollback': mark the poisoned step so the
-        replay skips it, restore the newest valid checkpoint, and
-        return the step to resume from."""
+    def _fit_grouped(self, batch_fn, num_steps, start_step,
+                     collect_training_stats=False):
+        """`steps_per_dispatch=k`: the engine's `lax.scan` k-step group
+        on the single-program path — ONE dispatch advances k steps
+        (amortizing per-dispatch RTT, PERF.md), data stacked
+        [k, G, ...]. The group program returns per-inner-step losses,
+        fetched only on checked groups, so the guard condemns the ONE
+        poisoned inner step and the window replays without it — same
+        granularity contract as the local-SGD `guard_inner_steps`
+        path, now the default for engine groups."""
+        from jax.sharding import PartitionSpec as P
+
+        net = self.net
         guard = self.guard
-        guard.note_rollback()
-        if guard.counters["rollbacks"] > guard.max_rollbacks:
-            raise NonFiniteLossError(
-                f"guard exceeded max_rollbacks={guard.max_rollbacks} "
-                f"(last verdict {verdict} at step {poisoned_step})")
-        self._poisoned_steps.add(poisoned_step)
-        restored = self.load_latest_checkpoint()
-        logger.warning(
-            "guard: %s at step %d — rolled back to checkpoint step %d; "
-            "step %d will be skipped on replay", verdict, poisoned_step,
-            restored, poisoned_step)
-        return restored
+        harness = self._harness
+        program = harness.program
+        program.require_sgd("TrainingMaster")
+        k = self.steps_per_dispatch
+        every = self.checkpoint_every
+        pp = self.phase_profiler
+        with self.mesh:
+            step = start_step
+            while step < num_steps:
+                self._check_preemption(step)
+                _fire("train.step")
+                _fire("train.hang")
+                fire_hang_hard()
+                harness.beat("dispatch", step=step)
+                if pp is not None:
+                    pp.begin_step(step)
+                    pp.mark("data_wait")
+                t0 = time.perf_counter()
+                span = min(step + k, num_steps) - step
+                group = []
+                abs_steps = []     # group index -> global step
+                for s in range(step, step + span):
+                    if s in self._poisoned_steps:
+                        continue   # rollback replay: skip poisoned data
+                    b = self._next_batch(batch_fn, s)
+                    if b is not None:
+                        group.append((self._maybe_poison(b[0]), b[1]))
+                        abs_steps.append(s)
+                if not group:
+                    step += span
+                    continue
+                if pp is not None:
+                    pp.mark("h2d")
+                xs = self._stage(np.stack([g[0] for g in group]),
+                                 P(None, "dp"))
+                ys = self._stage(np.stack([g[1] for g in group]),
+                                 P(None, "dp"))
+                t1 = time.perf_counter()
+                # guard at group granularity: one check per dispatch
+                # (already a 1/k sampling of the underlying steps)
+                check_now = guard is not None and guard.check_every > 0
+                snap = harness.pre_step_snapshot(check_now)
+                if pp is not None:
+                    pp.mark("dispatch")
+                program.run_group(xs, ys)
+                harness.beat("fetch", step=step)
+                if pp is not None:
+                    pp.mark("host_sync")
+                if check_now:
+                    # the scan group ALWAYS returns per-inner-step
+                    # losses: the FIRST non-finite one is the poisoned
+                    # step (the scan carries params, so every later
+                    # inner loss is downstream contamination — those
+                    # steps replay on clean state instead)
+                    inner = np.asarray(program.last_step_losses)
+                    finite = np.isfinite(inner)
+                    bad = ([abs_steps[int(np.argmax(~finite))]]
+                           if not finite.all() else [])
+                    if bad:
+                        guard.counters["checks"] += 1
+                        guard.counters["nonfinite"] += 1
+                        _obs.count("dl4j_train_guard_checks_total")
+                        _obs.count("dl4j_train_guard_nonfinite_total")
+                        self._poisoned_steps.update(bad)
+
+                        def _rollback_group():
+                            self._grouped_restore = \
+                                self.load_latest_checkpoint()
+
+                        action = harness.dispatch_verdict(
+                            "nonfinite", snap=snap,
+                            restore_rollback=_rollback_group,
+                            context=f"at inner step(s) {bad} of group "
+                                    f"at step {step}")
+                        if action == "skip":
+                            logger.warning(
+                                "guard: non-finite inner step(s) %s — "
+                                "window replayed without them", bad)
+                        else:   # rollback
+                            step = self._grouped_restore
+                        continue   # re-enter the window minus `bad`
+                    verdict = guard.post_step(net)
+                    if verdict != "ok":
+                        def _rollback_window():
+                            for s in range(step, step + span):
+                                self._poisoned_steps.add(s)
+                            self._grouped_restore = \
+                                self.load_latest_checkpoint()
+
+                        action = harness.dispatch_verdict(
+                            verdict, snap=snap,
+                            restore_rollback=_rollback_window,
+                            context=f"in group at step {step}")
+                        if action == "skip":
+                            step += span
+                        else:   # rollback
+                            step = self._grouped_restore
+                        continue
+                if collect_training_stats:
+                    # analyze: allow=jit-host-sync — opt-in stats barrier
+                    float(net.score())
+                t2 = time.perf_counter()
+                # group telemetry: steps_total counts the inner steps
+                # actually trained; step_seconds stays in per-step
+                # units (group wall time averaged over its steps)
+                self._obs_acc.count_observe(
+                    "dl4j_train_steps_total", "dl4j_train_step_seconds",
+                    (t2 - t0) / max(1, len(abs_steps)),
+                    n=len(abs_steps))
+                if self.tracer is not None:
+                    self.tracer.record(
+                        "train_group", t0, t2, cat="train",
+                        args={"step": step, "steps": len(abs_steps)})
+                for listener in net.listeners:
+                    listener.iteration_done(net, net.iteration)
+                prev = step
+                step += span
+                # checkpoint when the group CROSSES a cadence boundary
+                # (group ends rarely align with checkpoint_every)
+                if (self.checkpoint_dir and every
+                        and prev // every != step // every):
+                    if pp is not None:
+                        pp.mark("checkpoint")
+                    self.save_checkpoint(step)
+                if pp is not None:
+                    pp.end_step()
+                if collect_training_stats:
+                    self._stats.append({
+                        "step": prev,
+                        "data_ms": (t1 - t0) * 1e3,
+                        "fit_ms": (t2 - t1) * 1e3,
+                        "listener_ms": 0.0,
+                        "checkpoint_ms":
+                            (time.perf_counter() - t2) * 1e3,
+                    })
+        return self
 
     def _fit_local_sgd(self, batch_fn, num_steps, start_step,
                        collect_training_stats=False):
@@ -770,23 +853,9 @@ class TrainingMaster:
 
     def resilience_stats(self):
         """Guard / watchdog / preemption / restart counters (None when
-        no self-healing hook is attached and nothing was counted)."""
-        out = {
-            "guard": self.guard.stats() if self.guard else None,
-            "watchdog": self.watchdog.stats() if self.watchdog else None,
-            "preemption": (self.preemption.stats()
-                           if self.preemption else None),
-            "supervisor": (self.supervisor.stats()
-                           if self.supervisor else None),
-            "counters": dict(self._resil_counters),
-            "poisoned_steps": sorted(self._poisoned_steps),
-        }
-        if (all(v is None for k, v in out.items()
-                if k not in ("counters", "poisoned_steps"))
-                and not any(self._resil_counters.values())
-                and not self._poisoned_steps):
-            return None
-        return out
+        no self-healing hook is attached and nothing was counted) —
+        delegated to the shared harness, which owns the counters."""
+        return self._harness.resilience_stats()
 
     def export_stats_html(self, path: str):
         """Timeline HTML export (ref StatsUtils.exportStatsAsHtml)."""
@@ -907,7 +976,8 @@ class TrainingMaster:
         _obs.observe("dl4j_checkpoint_write_seconds", t1 - t0)
         if self.tracer is not None:
             self.tracer.record("checkpoint_save", t0, t1,
-                               cat="checkpoint", parent=self._step_span,
+                               cat="checkpoint",
+                               parent=self._harness.step_span,
                                args={"step": step})
         return result
 

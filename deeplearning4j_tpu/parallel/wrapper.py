@@ -25,16 +25,14 @@ sharding.py rules — a capability with no reference counterpart.
 
 from __future__ import annotations
 
-import time
-from functools import partial
-from typing import Any, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from deeplearning4j_tpu.observability import metrics as _obs
+from deeplearning4j_tpu.engine import StepHarness, make_loss_and_apply
 from deeplearning4j_tpu.parallel.mesh import make_mesh
 from deeplearning4j_tpu.parallel.sharding import (
     param_shardings,
@@ -107,9 +105,6 @@ class ParallelWrapper:
 
             self._snapshotter = PeriodicSnapshotter(
                 guard, every=snapshot_every)
-        self.guard = guard
-        self.watchdog = watchdog
-        self._guard_steps = 0
         if mesh is None:
             n = len(jax.devices())
             workers = workers if workers is not None else max(1, n // tp)
@@ -121,22 +116,19 @@ class ParallelWrapper:
         self.prefetch_buffer = prefetch_buffer
         self._sharded = False
         self._local_step = None
-        # per-step telemetry batches through an accumulator (flushed
-        # every 32 steps + at fit end) — appends, not registry locks
-        self._obs_acc = _obs.StepAccumulator()
-        # step phase attribution (observability/perf.py): every step
-        # funnels through _run_guarded, so dispatch/host_sync phases
-        # land there; data_wait/h2d are not visible at this altitude
-        if phase_profiler is True:
-            from deeplearning4j_tpu.observability.perf import (
-                StepPhaseProfiler,
-            )
-
-            phase_profiler = StepPhaseProfiler()
-        self.phase_profiler = phase_profiler
-        if (self.phase_profiler is not None
-                and self.phase_profiler.accumulator is None):
-            self.phase_profiler.accumulator = self._obs_acc
+        # ONE supervisor (engine/): guard-verdict dispatch, watchdog
+        # lifecycle, the StepAccumulator per-step telemetry batches
+        # through, and the phase profiler (every step funnels through
+        # _run_guarded, so dispatch/host_sync phases land there;
+        # data_wait/h2d are not visible at this altitude)
+        self._harness = StepHarness(
+            net, guard=guard, watchdog=watchdog,
+            snapshotter=self._snapshotter,
+            phase_profiler=phase_profiler)
+        self.guard = self._harness.guard
+        self.watchdog = self._harness.watchdog
+        self._obs_acc = self._harness.acc
+        self.phase_profiler = self._harness.phase_profiler
 
     # ------------------------------------------------------------------
     def _ensure_sharded(self):
@@ -176,60 +168,15 @@ class ParallelWrapper:
         return _pad_batch_with_masks(self.dp, x, y, fm, lm)
 
     def _run_guarded(self, thunk) -> bool:
-        """Run one training step/group under the NonFiniteGuard; False
-        means the step was rejected and the pre-step (skip_step) or
+        """Run one training step/group under the shared harness's
+        guard dispatch (engine.StepHarness.guarded); False means the
+        step was rejected and the pre-step (skip_step) or
         newest-snapshot (rollback) state restored (callers skip
-        listeners for rejected steps)."""
-        from deeplearning4j_tpu.resilience.errors import (
-            NonFiniteLossError,
-        )
-
-        g = self.guard
-        pp = self.phase_profiler
-        check = g is not None and g.should_check(self._guard_steps)
-        self._guard_steps += 1
-        if self._snapshotter is not None:
-            self._snapshotter.maybe_snapshot(self.net)
-        snap = (g.snapshot(self.net)
-                if check and g.policy == "skip_step" else None)
-        if pp is not None:
-            pp.begin_step(self._guard_steps - 1)
-            pp.mark("dispatch")
-        try:
-            t0 = time.perf_counter()
-            thunk()
-            if pp is not None:
-                pp.sync(getattr(self.net, "_score", None),
-                        step=self._guard_steps - 1)
-                pp.mark("host_sync")
-            # every ParallelWrapper step/group funnels through here: the
-            # one emission site covers single-step, local-SGD, and
-            # multi-io paths alike (batched; fit() flushes at loop end)
-            self._obs_acc.count_observe(
-                "dl4j_train_steps_total", "dl4j_train_step_seconds",
-                time.perf_counter() - t0)
-            if not check:
-                return True
-            verdict = g.post_step(self.net)
-            if verdict == "ok":
-                return True
-            if g.policy == "skip_step":
-                g.restore(self.net, snap)
-                g.note_skip()
-                return False
-            if g.policy == "rollback":
-                g.note_rollback()
-                if g.counters["rollbacks"] > g.max_rollbacks:
-                    raise NonFiniteLossError(
-                        f"guard exceeded max_rollbacks={g.max_rollbacks} "
-                        f"(last verdict {verdict})")
-                self._snapshotter.restore(self.net)
-                return False
-            raise NonFiniteLossError(
-                f"{verdict} training state detected (policy=abort)")
-        finally:
-            if pp is not None:
-                pp.end_step()
+        listeners for rejected steps). Every ParallelWrapper
+        step/group funnels through here: the one emission site covers
+        single-step, local-SGD, and multi-io paths alike (batched;
+        fit() flushes at loop end)."""
+        return self._harness.guarded(thunk, context="detected")
 
     # ------------------------------------------------------------------
     def fit(self, data, epochs: int = 1):
@@ -251,15 +198,11 @@ class ParallelWrapper:
             self._local_step = LocalStepTrainer(
                 net, self.mesh, average_updaters=self.average_updaters,
                 threshold=self.threshold_compression)
-        wd = self.watchdog
-        if wd is not None:
-            wd.start()
-        try:
-            self._fit_loop(batches, epochs, k, wd)
-        finally:
-            self._obs_acc.flush()
-            if wd is not None:
-                wd.stop()
+        # one shared session lifecycle (engine/): watchdog start/stop,
+        # accumulator flush, and attached-iterator close on the way out
+        self._harness.attach_data(batches)
+        with self._harness.session():
+            self._fit_loop(batches, epochs, k, self.watchdog)
         return self
 
     def _fit_loop(self, batches, epochs, k, wd):
@@ -294,37 +237,15 @@ class ParallelWrapper:
                            else shard_batch(self.mesh, jnp.asarray(fm)))
                     lmb = (None if lm is None
                            else shard_batch(self.mesh, jnp.asarray(lm)))
-                    if getattr(net.conf, "optimization_algo",
-                               "stochastic_gradient_descent") not in (
-                            "stochastic_gradient_descent", "sgd"):
-                        raise NotImplementedError(
-                            "line-search solvers are not supported under "
-                            "ParallelWrapper; use the default "
-                            "stochastic_gradient_descent")
-                    is_tbptt = (getattr(net.conf, "backprop_type", None)
-                                == "truncated_bptt"
-                                and getattr(xb, "ndim", 0) == 3)
+                    program = self._harness.program
+                    program.require_sgd("ParallelWrapper")
 
-                    def one_step(xb=xb, yb=yb, fmb=fmb, lmb=lmb,
-                                 is_tbptt=is_tbptt):
-                        if hasattr(net.conf, "network_inputs"):
-                            # ComputationGraph: dict inputs / list labels
-                            name = net.conf.network_inputs[0]
-                            ins = {name: xb}
-                            fms_in = None if fmb is None else {name: fmb}
-                            lms_in = None if lmb is None else [lmb]
-                            if is_tbptt:
-                                net._fit_tbptt(ins, [yb], fms_in, lms_in)
-                            else:
-                                net._train_step(ins, [yb], fms_in,
-                                                lms_in)
-                        elif is_tbptt:
-                            # time-chunked steps with carried RNN state;
-                            # the sharded batch dim flows through the
-                            # chunk slices
-                            net._fit_tbptt(xb, yb, fmb, lmb)
-                        else:
-                            net._train_step(xb, yb, fmb, lmb)
+                    def one_step(xb=xb, yb=yb, fmb=fmb, lmb=lmb):
+                        # the shared StepProgram owns the graph-input /
+                        # TBPTT dispatch; the sharded batch dim flows
+                        # through unchanged (GSPMD inserts the grad
+                        # all-reduce into the same compiled step)
+                        program.run(xb, yb, fmb, lmb)
 
                     if self._run_guarded(one_step):
                         for listener in net.listeners:
@@ -401,56 +322,10 @@ def _pad_batch_with_masks(dp, x, y, fm, lm):
     return x, y, fm, lm
 
 
-def _make_loss_and_apply(net):
-    """(loss_for_grad, apply_updates) closures over a net — shared by
-    the local-SGD and stale-gradient trainers."""
-    conf = net.conf
-    cd = net.compute_dtype
-    is_graph = hasattr(conf, "network_inputs")
-
-    def loss_for_grad(params, states, x, y, rng, fm, lm):
-        if cd is not None:
-            from deeplearning4j_tpu.nn.dtype import cast_floating
-            params = cast_floating(params, cd)
-            x = cast_floating(x, cd)
-        loss, (new_states, _) = net._loss_fn(
-            params, states, x, y, rng, fm, lm, rnn_carries=None)
-        if cd is not None:
-            loss = loss.astype(net.dtype)
-        return loss, new_states
-
-    if is_graph:
-        layer_names = [n.name for n in net.topo if n.kind == "layer"]
-        frozen = {n.name for n in net.topo
-                  if n.kind == "layer" and n.obj.frozen}
-        lr_factors = {
-            n.name: ((n.obj.learning_rate / conf.learning_rate)
-                     if getattr(n.obj, "learning_rate", None) is not None
-                     and conf.learning_rate != 0 else 1.0)
-            for n in net.topo if n.kind == "layer"}
-
-        def apply_updates(params, upd_states, grads, lr, step):
-            from deeplearning4j_tpu.nn.updater import fused_apply
-            np_list, nu_list = fused_apply(
-                [(net._updaters[name], lr_factors[name], name in frozen,
-                  params[name], grads[name], upd_states[name])
-                 for name in layer_names], lr, step)
-            return (dict(zip(layer_names, np_list)),
-                    dict(zip(layer_names, nu_list)))
-    else:
-        lr_factors = [
-            (l.learning_rate / conf.learning_rate)
-            if l.learning_rate is not None and conf.learning_rate != 0
-            else 1.0 for l in conf.layers]
-
-        def apply_updates(params, upd_states, grads, lr, step):
-            from deeplearning4j_tpu.nn.updater import fused_apply
-            return fused_apply(
-                [(net._updaters[i], lr_factors[i], conf.layers[i].frozen,
-                  params[i], grads[i], upd_states[i])
-                 for i in range(len(params))], lr, step)
-
-    return loss_for_grad, apply_updates
+# the step math lives with the engine now (ONE source for the single
+# step, the k-step group, and both shard_map trainers below); the old
+# private name stays importable for downstream callers
+_make_loss_and_apply = make_loss_and_apply
 
 
 class LocalStepTrainer:

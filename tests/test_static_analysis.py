@@ -370,3 +370,72 @@ def test_install_from_env_gating(monkeypatch):
         assert sanitizers.install_from_env() is san
     finally:
         san.uninstall()
+
+
+# ================================= call-graph reachability (PR 9)
+def _reach(src: str, tmp_path):
+    """build_reachable over a one-file synthetic package."""
+    from deeplearning4j_tpu.analysis.jit_lint import build_reachable
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(textwrap.dedent(src))
+    return build_reachable(load_sources(pkg, tmp_path))
+
+
+def test_reachability_resolves_self_calls_through_hierarchy(tmp_path):
+    """`self.m()` follows REAL class-hierarchy edges: the override in a
+    subclass is reachable (virtual dispatch), while a same-named method
+    on an UNRELATED class no longer rides the name-match."""
+    seen = _reach(
+        """
+        class Base:
+            def fit(self):
+                self.step()
+            def step(self):
+                pass
+        class Child(Base):
+            def step(self):          # override: virtually dispatched
+                pass
+        class Unrelated:
+            def step(self):          # same name, different hierarchy
+                pass
+        """, tmp_path)
+    assert "pkg/mod.py::Base.fit" in seen
+    assert "pkg/mod.py::Base.step" in seen
+    assert "pkg/mod.py::Child.step" in seen
+    assert "pkg/mod.py::Unrelated.step" not in seen
+
+
+def test_reachability_falls_back_to_names_when_unresolvable(tmp_path):
+    """A call that is NOT a self-call keeps the conservative name-based
+    edge — false reachability costs a pragma, a missed hot function
+    costs an untraced recompile."""
+    seen = _reach(
+        """
+        def fit(runner):
+            runner.launch()
+        class Elsewhere:
+            def launch(self):
+                pass
+        """, tmp_path)
+    assert "pkg/mod.py::Elsewhere.launch" in seen
+
+
+def test_engine_entry_points_are_reachability_roots():
+    """The StepProgram/StepHarness entry points are roots by exact
+    qualname: everything the compiled-step path can execute is hot
+    even if no `fit`-named function calls it in the scanned set."""
+    from deeplearning4j_tpu.analysis.jit_lint import (
+        ROOT_QUALNAMES,
+        build_reachable,
+    )
+
+    sources = load_sources(PKG, ROOT)
+    seen = build_reachable(sources)
+    for qual in sorted(ROOT_QUALNAMES):
+        assert qual in seen, f"engine root {qual} not in reachable set"
+    # and the walk actually descends from them: the group builder is
+    # only called from run_group
+    assert ("deeplearning4j_tpu/engine/step_program.py::"
+            "StepProgram._build_group") in seen
