@@ -29,11 +29,26 @@ Edges aggregate by creation site, not lock instance, so an A→B/B→A
 inversion between two *instances* of the same pair of sites is still a
 cycle — exactly how native lock-order sanitizers (e.g. TSan's deadlock
 detector) aggregate.
+
+queue.Queue put/get ordering rides the SAME graph (the closed analyzer
+gap): a `queue.Queue` created from an in-scope file becomes a node
+(`q:file:line`). A *blocking* put on a BOUNDED queue (the only put
+that can wedge) while holding lock L records the producer edge
+``L -> Q``; after a blocking get returns, every lock the consumer
+acquires before its next queue operation records the handoff edge
+``Q -> L`` — "processing the item needs L". Together they catch the
+classic coupled-queue deadlock (producer holds L blocked on a full
+put; the consumer that would drain it needs L) as the cycle
+``L -> Q -> L``, even on runs where the interleaving got lucky. Only
+the three methods are instrumented — the queue's internal mutex and
+conditions are created from stdlib frames and stay REAL C locks (see
+DEFAULT_SCOPE below for why that is load-bearing).
 """
 
 from __future__ import annotations
 
 import os
+import queue as _queue_mod
 import threading
 import time
 import traceback
@@ -42,9 +57,12 @@ from typing import Dict, List, Optional, Set, Tuple
 
 ENV_VAR = "DL4J_TPU_SANITIZE"
 
-# real factories, captured before any install() can patch them
+# real factories/methods, captured before any install() can patch them
 _REAL_LOCK = threading.Lock
 _REAL_RLOCK = threading.RLock
+_REAL_Q_INIT = _queue_mod.Queue.__init__
+_REAL_Q_PUT = _queue_mod.Queue.put
+_REAL_Q_GET = _queue_mod.Queue.get
 
 _ACTIVE: Optional["LockOrderSanitizer"] = None
 
@@ -70,6 +88,11 @@ class _Held:
 class _HeldStack(threading.local):
     def __init__(self):
         self.stack: List[_Held] = []
+        # queue-handoff marker: the site of the tracked queue this
+        # thread last blocking-got from (None once the thread performs
+        # its next queue operation) — locks acquired while it is set
+        # record the consumer edge Q -> L
+        self.qmark: Optional[str] = None
 
 
 @dataclass
@@ -191,6 +214,35 @@ class LockOrderSanitizer:
 
         threading.Lock = make_lock
         threading.RLock = make_rlock
+
+        # queue.Queue: instrument the three methods IN PLACE (so
+        # pre-existing subclasses stay subclasses); only instances
+        # created from in-scope frames get a `_san_site` and report.
+        # The queue's own mutex/conditions come from stdlib creation
+        # frames and therefore stay real C locks.
+        def q_init(q, maxsize: int = 0):
+            _REAL_Q_INIT(q, maxsize)
+            path, lineno = _creation_frame(san._skip)
+            if any(p in path for p in san.scope):
+                q._san_site = f"q:{os.path.basename(path)}:{lineno}"
+
+        def q_put(q, item, block: bool = True, timeout=None):
+            site = getattr(q, "_san_site", None)
+            # only a blocking put on a BOUNDED queue can wedge
+            if site is not None and block and q.maxsize > 0:
+                san._note_queue_put(site)
+            return _REAL_Q_PUT(q, item, block, timeout)
+
+        def q_get(q, block: bool = True, timeout=None):
+            item = _REAL_Q_GET(q, block, timeout)
+            site = getattr(q, "_san_site", None)
+            if site is not None and block:
+                san._note_queue_get(site)
+            return item
+
+        _queue_mod.Queue.__init__ = q_init
+        _queue_mod.Queue.put = q_put
+        _queue_mod.Queue.get = q_get
         self._installed = True
         _ACTIVE = self
         return self
@@ -201,11 +253,25 @@ class LockOrderSanitizer:
             return
         threading.Lock = _REAL_LOCK
         threading.RLock = _REAL_RLOCK
+        _queue_mod.Queue.__init__ = _REAL_Q_INIT
+        _queue_mod.Queue.put = _REAL_Q_PUT
+        _queue_mod.Queue.get = _REAL_Q_GET
         self._installed = False
         if _ACTIVE is self:
             _ACTIVE = None
 
     # ----------------------------------------------------- accounting
+    def _record_edge(self, src: str, dst: str) -> None:
+        if src == dst:
+            return
+        key = (src, dst)
+        if key not in self._edges:
+            tb = "".join(traceback.format_stack(limit=8)[:-2])
+            with self._meta:
+                if key not in self._edges:
+                    self._edges[key] = Edge(
+                        src, dst, threading.current_thread().name, tb)
+
     def _note_acquire(self, proxy: _LockProxy) -> None:
         stack = self._held.stack
         for held in stack:
@@ -214,18 +280,28 @@ class LockOrderSanitizer:
                 return
         now = time.perf_counter()
         if stack:
-            src = stack[-1].proxy._site
-            dst = proxy._site
-            if src != dst:
-                key = (src, dst)
-                if key not in self._edges:
-                    tb = "".join(traceback.format_stack(limit=8)[:-2])
-                    with self._meta:
-                        if key not in self._edges:
-                            self._edges[key] = Edge(
-                                src, dst,
-                                threading.current_thread().name, tb)
+            self._record_edge(stack[-1].proxy._site, proxy._site)
+        if self._held.qmark is not None:
+            # consumer half of a queue handoff: processing the item
+            # this thread got from Q needs this lock  =>  Q -> L
+            self._record_edge(self._held.qmark, proxy._site)
         stack.append(_Held(proxy, 1, now))
+
+    def _note_queue_put(self, site: str) -> None:
+        """Blocking put on a bounded tracked queue: producer edge
+        held-lock -> Q (the put can wedge while the lock is held)."""
+        stack = self._held.stack
+        if stack:
+            self._record_edge(stack[-1].proxy._site, site)
+        self._held.qmark = None       # a queue op ends the handoff window
+
+    def _note_queue_get(self, site: str) -> None:
+        """Blocking get returned: open the handoff window — locks this
+        thread acquires before its next queue op record Q -> L."""
+        if self._held.stack:
+            # a blocking get UNDER a lock is itself a wedge hazard
+            self._record_edge(self._held.stack[-1].proxy._site, site)
+        self._held.qmark = site
 
     def _note_release(self, proxy: _LockProxy,
                       all_levels: bool = False) -> None:

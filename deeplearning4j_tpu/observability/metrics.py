@@ -102,6 +102,9 @@ REGISTERED_METRICS = frozenset({
     "dl4j_breaker_transitions_total",
     "dl4j_cluster_gang_restarts_total",
     "dl4j_cluster_quarantined_workers_total",
+    "dl4j_cluster_spare_reschedules_total",
+    "dl4j_cluster_shrinks_total",
+    "dl4j_cluster_world_size",
     # derived by the registry itself (no count()/observe() call site)
     "dl4j_obs_dropped_emissions_total",
 })

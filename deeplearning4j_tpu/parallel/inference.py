@@ -60,6 +60,7 @@ import logging
 import queue
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
@@ -87,6 +88,40 @@ class InferenceMode:
     BATCHED = "batched"
 
 
+# priority classes (mirrors serving/admission.py PRIORITY_CLASSES —
+# kept literal here so the data plane never imports the control plane)
+_PRIORITY_IDX = {"high": 0, "normal": 1, "low": 2}
+
+
+class _RequestQueue(queue.Queue):
+    """Bounded request queue with priority-class ordering: admitted
+    requests dequeue high-before-normal-before-low, FIFO within one
+    class — under a deep queue an admitted high-priority request no
+    longer waits behind a wall of admitted normals.
+
+    Built on queue.Queue's documented `_init/_qsize/_put/_get`
+    extension points (the same mechanism queue.PriorityQueue uses), so
+    the mutex and condition variables stay the stdlib-created C locks —
+    load-bearing: daemon pipeline threads wait on them through
+    interpreter finalization, where a pure-Python acquire frame is
+    fatal (see analysis/sanitizers.py DEFAULT_SCOPE)."""
+
+    def _init(self, maxsize: int) -> None:
+        self._by_class = tuple(deque() for _ in range(3))
+
+    def _qsize(self) -> int:
+        return sum(len(d) for d in self._by_class)
+
+    def _put(self, item) -> None:
+        self._by_class[getattr(item, "priority_idx", 1)].append(item)
+
+    def _get(self):
+        for d in self._by_class:
+            if d:
+                return d.popleft()
+        raise queue.Empty   # unreachable: guarded by queue.Queue's CV
+
+
 class _Pending:
     """One caller's request — one or more equal-row input arrays (a
     multi-input ComputationGraph request is a tuple of named-input
@@ -97,15 +132,17 @@ class _Pending:
     lives in exactly one batch and batches touch disjoint ranges), so
     no lock of its own is needed."""
 
-    __slots__ = ("xs", "event", "result", "_left", "_out", "span")
+    __slots__ = ("xs", "event", "result", "_left", "_out", "span",
+                 "priority_idx")
 
-    def __init__(self, xs):
+    def __init__(self, xs, priority_idx: int = 1):
         self.xs = xs               # tuple of per-input arrays
         self.event = threading.Event()
         self.result = None
         self._left = xs[0].shape[0]
         self._out = None           # list of per-output buffers (splits)
         self.span = None   # open request span (tracer attached only)
+        self.priority_idx = priority_idx   # dequeue class (0 first)
 
     @property
     def rows(self) -> int:
@@ -202,7 +239,8 @@ class ParallelInference:
         self.pipeline_depth = max(0, int(pipeline_depth))
         self.completion_streams = max(1, int(completion_streams))
         self._cap = self._bucket(batch_limit)   # hard bucket-shape ceiling
-        self._queue: "queue.Queue[_Pending]" = queue.Queue(maxsize=queue_limit)
+        self._queue: "queue.Queue[_Pending]" = _RequestQueue(
+            maxsize=queue_limit)
         self._lock = threading.Lock()
         self._count_lock = threading.Lock()   # _inflight_n (k completers)
         self._stop = threading.Event()
@@ -384,11 +422,18 @@ class ParallelInference:
         return (self._completer is not None
                 and not self._completer.is_alive())
 
-    def output(self, *xs, timeout_s: Optional[float] = None):
+    def output(self, *xs, timeout_s: Optional[float] = None,
+               priority: Optional[str] = None):
         """Run inference; raises OverloadedError when the bounded queue
         is full (shed load, don't queue unbounded latency) and
         DeadlineExceededError / InferenceUnavailableError instead of
         hanging when the pipeline stalls or dies.
+
+        `priority` ("high"/"normal"/"low", default normal — the
+        admission layer passes the tenant's class): admitted requests
+        DEQUEUE high-before-normal-before-low under a deep queue, FIFO
+        within a class; admission sheds by class before the queue,
+        this orders within it.
 
         Multi-input graphs pass one array per network input
         (`pi.output(x_a, x_b)`), all sharing the batch dim — the
@@ -412,7 +457,7 @@ class ParallelInference:
                         if isinstance(out, (list, tuple))
                         else np.asarray(out))
         self._check_available()
-        p = _Pending(xs)
+        p = _Pending(xs, priority_idx=_PRIORITY_IDX.get(priority, 1))
         if self.tracer is not None:
             try:
                 p.span = self.tracer.begin(

@@ -26,6 +26,7 @@ broadcast" recovery, made explicit.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import os
@@ -82,7 +83,8 @@ class TrainingMaster:
                  guard_inner_steps: bool = False,
                  tracer=None,
                  phase_profiler=None,
-                 steps_per_dispatch: int = 1):
+                 steps_per_dispatch: int = 1,
+                 per_rank_checkpoints: bool = False):
         """`averaging_frequency=k > 1` runs k-step local SGD between
         parameter rendezvous — each dp shard trains privately for k
         steps, then params (+ updater state) are averaged. This is the
@@ -102,6 +104,25 @@ class TrainingMaster:
             raise ValueError(
                 f"checkpoint_format must be npz|orbax: {checkpoint_format}")
         self.net = net
+        # per-rank checkpoint copies (`<dir>/rank-<r>/`): EVERY process
+        # writes its own copy instead of process 0 alone — the input
+        # the ClusterSupervisor's divergence quorum votes over (a
+        # silently forked replica is out-voted, quarantined aside, and
+        # healed before any resume). Replicated dp training makes the
+        # copies the same state, so the canonical state digest
+        # (recorded in each manifest at save) compares equal.
+        self.per_rank_checkpoints = bool(per_rank_checkpoints)
+        if self.per_rank_checkpoints and checkpoint_format != "npz":
+            raise ValueError(
+                "per_rank_checkpoints requires checkpoint_format='npz' "
+                "(the divergence quorum votes over npz state digests)")
+        if self.per_rank_checkpoints and checkpoint_dir:
+            from deeplearning4j_tpu.resilience.checkpoint_integrity import (
+                rank_checkpoint_dir,
+            )
+
+            checkpoint_dir = rank_checkpoint_dir(
+                checkpoint_dir, jax.process_index())
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = checkpoint_every
         self.checkpoint_format = checkpoint_format
@@ -227,6 +248,25 @@ class TrainingMaster:
 
         return jax.process_index(), jax.process_count()
 
+    def world_info(self) -> dict:
+        """The LIVE world this master trains in: process count (the
+        dp-average denominator's host axis after a shrink-to-fit
+        relaunch), device count, and the mesh's dp extent. Everything
+        that shards data or averages across replicas derives from
+        these live values — never from a configured world size — so an
+        elastic gang that relaunches smaller re-derives its global
+        batch semantics automatically."""
+        import jax
+
+        try:
+            dp = int(self.mesh.shape.get("dp", 1))
+        except Exception:   # noqa: BLE001 - exotic mesh: report devices
+            dp = len(jax.devices())
+        return {"processes": int(jax.process_count()),
+                "devices": len(jax.devices()),
+                "dp": dp,
+                "per_rank_checkpoints": self.per_rank_checkpoints}
+
     # ------------------------------------------------------------- staging
     def _replicated(self, tree):
         import jax
@@ -312,6 +352,12 @@ class TrainingMaster:
         monitor thread parents its hang events to the current step
         span."""
         self._stage_net()
+        # the live world: data sharding and the dp-average denominator
+        # derive from THIS (mesh over the processes actually present),
+        # so a shrink-to-fit relaunch predictably re-averages the loss
+        # over the surviving replicas; the gauge makes it scrapeable
+        _obs.set_gauge("dl4j_cluster_world_size",
+                       self.world_info()["processes"])
         guard = self.guard
         if start_step is None:
             start_step = self.load_latest_checkpoint()
@@ -1000,7 +1046,9 @@ class TrainingMaster:
 
         if self.checkpoint_format == "orbax":
             return self._save_orbax(step)
-        if jax.process_index() != 0:
+        # per-rank mode: EVERY process writes its own copy (into its
+        # rank-<r> dir) — the divergence quorum's voters
+        if jax.process_index() != 0 and not self.per_rank_checkpoints:
             return
         os.makedirs(self.checkpoint_dir, exist_ok=True)
         net = self.net
@@ -1018,6 +1066,18 @@ class TrainingMaster:
         payload["epoch"] = np.asarray(int(net.epoch))
         final = self._ckpt_path(step)
         fn = os.path.basename(final)
+        # canonical state digest (container-timestamp-immune): what the
+        # cross-rank divergence quorum compares — identical replicated
+        # state hashes equal on every rank even though the zip bytes
+        # differ
+        state_h = hashlib.sha256()
+        for k in sorted(payload):
+            a = np.ascontiguousarray(payload[k])
+            state_h.update(k.encode())
+            state_h.update(str(a.dtype).encode())
+            state_h.update(str(a.shape).encode())
+            state_h.update(a.tobytes())
+        state_sha = state_h.hexdigest()
 
         def _write():
             with _ci.atomic_writer(final, suffix=".tmp.npz") as tmp:
@@ -1030,7 +1090,8 @@ class TrainingMaster:
                 # past the atomic publish — caught by the checksum
                 _fire("checkpoint.write", path=tmp)
             _ci.record_checksum(self.checkpoint_dir, fn, digest, size,
-                                extra={"step": step})
+                                extra={"step": step,
+                                       "state_sha256": state_sha})
 
         self._ckpt_retry.call(_write)
         meta = {"step": step, "iteration": int(net.iteration),

@@ -13,6 +13,7 @@ errors here.
 """
 
 from deeplearning4j_tpu.resilience.errors import (
+    CheckpointDivergenceError,
     CheckpointIntegrityError,
     CircuitOpenError,
     DeadlineExceededError,
@@ -45,12 +46,17 @@ from deeplearning4j_tpu.resilience.checkpoint_integrity import (
     atomic_write_bytes,
     atomic_write_json,
     atomic_writer,
+    compute_state_digest,
+    divergence_quorum,
     list_all_checkpoints,
     newest_valid_checkpoint,
+    quorum_resume_step,
+    rank_checkpoint_dir,
     record_checksum,
     require_valid,
     require_valid_tree,
     sha256_file,
+    state_digest,
     validate_file,
     validate_tree,
     write_tree_manifest,
@@ -73,7 +79,8 @@ from deeplearning4j_tpu.resilience.cluster import (
 )
 
 __all__ = [
-    "CheckpointIntegrityError", "CircuitOpenError",
+    "CheckpointDivergenceError", "CheckpointIntegrityError",
+    "CircuitOpenError",
     "DeadlineExceededError", "FaultInjectedError",
     "InferenceUnavailableError", "ModelNotFoundError",
     "NoHealthyReplicaError", "NonFiniteLossError", "OverloadedError",
@@ -88,8 +95,10 @@ __all__ = [
     "EXIT_HANG", "EXIT_NAN", "ClusterSupervisor", "HeartbeatFile",
     "heartbeat_path", "reap_stray_workers",
     "apply_retention", "atomic_write_bytes", "atomic_write_json",
-    "atomic_writer", "list_all_checkpoints", "newest_valid_checkpoint",
-    "record_checksum", "require_valid", "require_valid_tree",
-    "sha256_file", "validate_file", "validate_tree",
+    "atomic_writer", "compute_state_digest", "divergence_quorum",
+    "list_all_checkpoints", "newest_valid_checkpoint",
+    "quorum_resume_step", "rank_checkpoint_dir", "record_checksum",
+    "require_valid", "require_valid_tree", "sha256_file",
+    "state_digest", "validate_file", "validate_tree",
     "write_tree_manifest",
 ]

@@ -22,6 +22,17 @@ Orbax-format checkpoints get the same story through a *tree manifest*
 per-file sha256 + size recorded at save, verified before restore, so a
 torn orbax directory is skipped by the newest-valid fallback scan
 exactly like a torn .npz.
+
+Per-rank divergence quorum (elastic-cluster resume): when every rank
+writes its OWN checkpoint copy (`rank-<r>/step-N.npz`), replicated
+data-parallel training makes those copies the same *state* — so before
+any resume the copies can out-vote a silently forked replica.
+`quorum_resume_step` elects the newest step whose canonical *state
+digest* (sha256 over the array contents, container-timestamp-immune)
+is held by a strict majority of ranks; minority/invalid/missing ranks
+are HEALED — the divergent copy is renamed aside (never deleted) and
+the quorum copy takes its place — and a no-quorum tie fails loudly
+with CheckpointDivergenceError instead of electing an arbitrary fork.
 """
 
 from __future__ import annotations
@@ -29,13 +40,19 @@ from __future__ import annotations
 import contextlib
 import hashlib
 import json
+import logging
 import os
 import re
 import shutil
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from deeplearning4j_tpu.observability import metrics as _obs
-from deeplearning4j_tpu.resilience.errors import CheckpointIntegrityError
+from deeplearning4j_tpu.resilience.errors import (
+    CheckpointDivergenceError,
+    CheckpointIntegrityError,
+)
+
+logger = logging.getLogger("deeplearning4j_tpu")
 
 MANIFEST = "manifest.json"
 _STEP_RE = re.compile(r"step-(\d+)\.npz$")
@@ -243,6 +260,159 @@ def newest_valid_checkpoint(directory: str,
             except Exception:   # noqa: BLE001 - any load failure = invalid
                 continue
         return step
+    return None
+
+
+# ------------------------------------------------- divergence quorum
+DIVERGENT_SUFFIX = ".divergent"
+
+
+def rank_checkpoint_dir(base: str, rank: int) -> str:
+    """Rank `rank`'s own checkpoint directory under the shared base —
+    one convention so workers and the supervisor derive it alike."""
+    return os.path.join(base, f"rank-{rank}")
+
+
+def step_filename(step: int) -> str:
+    return f"step-{step:08d}.npz"
+
+
+def compute_state_digest(path: str) -> str:
+    """Canonical digest of the ARRAYS inside a .npz checkpoint: sorted
+    keys, dtype/shape/raw bytes. Two ranks holding the same training
+    state hash equal even though the zip containers differ (per-entry
+    timestamps) — the comparator the divergence quorum votes with."""
+    import numpy as np
+
+    h = hashlib.sha256()
+    with np.load(path, allow_pickle=False) as z:
+        for k in sorted(z.files):
+            a = np.ascontiguousarray(z[k])
+            h.update(k.encode())
+            h.update(str(a.dtype).encode())
+            h.update(str(a.shape).encode())
+            h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def state_digest(directory: str, filename: str) -> Optional[str]:
+    """The recorded state digest for `filename` (written at save into
+    the manifest), recomputed from the file when the manifest predates
+    it. None when the file is missing or unreadable (no vote)."""
+    entry = read_manifest(directory).get(filename)
+    if entry and "state_sha256" in entry:
+        return entry["state_sha256"]
+    path = os.path.join(directory, filename)
+    if not os.path.exists(path):
+        return None
+    try:
+        return compute_state_digest(path)
+    except Exception:   # noqa: BLE001 - torn/corrupt file: no vote
+        return None
+
+
+def divergence_quorum(base_dir: str, nprocs: int, step: int,
+                      heal: bool = True) -> dict:
+    """Compare every rank's copy of checkpoint `step` and elect the
+    quorum state digest.
+
+    A digest wins when it is held by a strict majority of the gang
+    (`> nprocs // 2`) and by strictly more ranks than any rival digest.
+    Minority / torn / missing ranks are then HEALED (with `heal=True`):
+    a divergent copy is renamed aside with ``.divergent`` (never
+    deleted) and the quorum rank's file + manifest entry are copied
+    into place, so every rank resumes from the SAME bytes. Two or more
+    digests with no such winner is a fork with no ground truth —
+    CheckpointDivergenceError, fail loudly before any resume. A single
+    digest held only by a minority elects nothing (``digest: None`` —
+    the step simply lacks enough copies; callers fall back to an older
+    step).
+
+    Returns ``{"step", "digest", "ranks": {rank: digest|None},
+    "healed": [rank...], "quarantined": [path...]}``."""
+    fn = step_filename(step)
+    ranks = list(range(int(nprocs)))
+    digests: Dict[int, Optional[str]] = {}
+    for r in ranks:
+        d = rank_checkpoint_dir(base_dir, r)
+        digests[r] = (state_digest(d, fn)
+                      if validate_file(d, fn) else None)
+    tally: Dict[str, List[int]] = {}
+    for r, dg in digests.items():
+        if dg is not None:
+            tally.setdefault(dg, []).append(r)
+    report = {"step": int(step), "digest": None, "ranks": digests,
+              "healed": [], "quarantined": []}
+    if not tally:
+        return report
+    ordered = sorted(tally.items(), key=lambda kv: (-len(kv[1]), kv[0]))
+    top_digest, top_ranks = ordered[0]
+    majority = len(top_ranks) > len(ranks) // 2
+    contested = len(ordered) > 1
+    if contested and (not majority
+                      or len(ordered[1][1]) == len(top_ranks)):
+        raise CheckpointDivergenceError(
+            f"checkpoint step {step} diverges across ranks with no "
+            f"quorum: {[(dg[:12], rs) for dg, rs in ordered]} — "
+            "refusing to elect a fork", step=int(step),
+            votes={dg: list(rs) for dg, rs in tally.items()})
+    if not majority:
+        return report          # one digest, too few copies: no quorum
+    report["digest"] = top_digest
+    if not heal:
+        return report
+    src_dir = rank_checkpoint_dir(base_dir, top_ranks[0])
+    src = os.path.join(src_dir, fn)
+    src_entry = read_manifest(src_dir).get(fn)
+    for r in ranks:
+        if digests[r] == top_digest:
+            continue
+        d = rank_checkpoint_dir(base_dir, r)
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, fn)
+        if os.path.exists(path):
+            aside = path + DIVERGENT_SUFFIX
+            i = 0
+            while os.path.exists(aside):
+                i += 1
+                aside = f"{path}{DIVERGENT_SUFFIX}.{i}"
+            os.replace(path, aside)   # quarantined aside, never deleted
+            report["quarantined"].append(aside)
+            logger.warning(
+                "divergence quorum: rank %d checkpoint step %d "
+                "out-voted (%s vs quorum %s) — quarantined to %s",
+                r, step, (digests[r] or "invalid")[:12],
+                top_digest[:12], aside)
+        shutil.copy2(src, path)
+        if src_entry is not None:
+            extra = {k: v for k, v in src_entry.items()
+                     if k not in ("sha256", "size")}
+            record_checksum(d, fn, src_entry["sha256"],
+                            src_entry["size"], extra=extra)
+        else:
+            record_checksum(d, fn, sha256_file(path),
+                            os.path.getsize(path),
+                            extra={"step": int(step),
+                                   "state_sha256": top_digest})
+        report["healed"].append(r)
+    return report
+
+
+def quorum_resume_step(base_dir: str, nprocs: int,
+                       heal: bool = True) -> Optional[dict]:
+    """The per-rank analogue of `newest_valid_checkpoint` with the
+    divergence gate in front: the newest step whose state digest has
+    quorum across the `nprocs` rank directories, minorities healed.
+    Raises CheckpointDivergenceError when the newest contested step is
+    an unresolvable fork; returns None when no step has quorum."""
+    steps = set()
+    for r in range(int(nprocs)):
+        steps.update(list_step_checkpoints(
+            rank_checkpoint_dir(base_dir, r)))
+    for step in sorted(steps, reverse=True):
+        report = divergence_quorum(base_dir, nprocs, step, heal=heal)
+        if report["digest"] is not None:
+            return report
     return None
 
 

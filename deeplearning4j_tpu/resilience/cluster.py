@@ -42,10 +42,44 @@ Fault domains detected, in detection order:
                      the ledger like any other fault.
 
 Repeatedly failing members are QUARANTINED: each worker carries a
-restart budget (`max_restarts_per_worker`); the member that exhausts it
-is recorded and the whole gang aborts with RestartsExhaustedError
-carrying the full ledger — bounded recovery, never an indefinite hang.
-`max_gang_restarts` bounds the total independently.
+restart budget (`max_restarts_per_worker`); exhausting it retires the
+member's SLOT. What happens next is the elastic part:
+
+  spare pool      `spares=N` holds N standby slots. A quarantined
+                  rank is RESCHEDULED onto a spare — fresh working
+                  directory (a bad host's local disk is suspect), same
+                  rank id, restart budget reset — and the gang
+                  relaunches on a fresh coordinator port. One bad host
+                  costs a reschedule, not the job. The per-slot ledger
+                  records every activation/quarantine/reschedule.
+  shrink-to-fit   with no spare left and `allow_shrink=True`, the gang
+                  relaunches at REDUCED world size (floor
+                  `min_workers`): the quarantined member is retired,
+                  survivors are re-ranked 0..n-1, and every worker
+                  learns the new world size through the same resume
+                  handshake (command_fn's nprocs argument) — data
+                  sharding and the dp-average denominator re-derive
+                  from the live world size, so global batch semantics
+                  degrade predictably instead of the job dying.
+  abort           only when spares are gone and shrink is disallowed
+                  (or would go below `min_workers`) does the gang
+                  abort with RestartsExhaustedError carrying the full
+                  ledger — still bounded recovery, never a hang. The
+                  `dist.spare_exhausted` fault point fires at exactly
+                  that juncture so the no-spare path is drillable.
+
+`max_gang_restarts` bounds the total restart count independently, and
+`dl4j_cluster_world_size` / `dl4j_cluster_spare_reschedules_total` /
+`dl4j_cluster_shrinks_total` make every elastic event visible on a
+/metrics scrape.
+
+With `per_rank_checkpoints=True` every rank writes its own checkpoint
+copy (`<checkpoint_dir>/rank-<r>/`) and the resume handshake runs the
+checkpoint_integrity divergence quorum BEFORE any resume: the newest
+step whose state digest a strict majority of ranks agree on wins,
+minority (silently forked / torn) copies are quarantined aside and
+healed from the quorum copy, and an unresolvable tie fails loudly with
+CheckpointDivergenceError.
 
 The `dist.heartbeat_stale` fault point fires at every lease check; an
 armed `raise` spec is consumed as a forced stale verdict, so the
@@ -142,10 +176,20 @@ class HeartbeatFile:
     calling write() — wedged, killed, or swallowed by a native
     collective — goes stale without any cooperation from the worker."""
 
-    def __init__(self, path: str, min_interval_s: float = 0.2):
+    def __init__(self, path: str, min_interval_s: float = 0.2,
+                 world_size: Optional[int] = None,
+                 slot: Optional[int] = None):
+        """`world_size` and `slot` (the elastic-gang identity this
+        worker was launched with) ride in every lease record, so the
+        supervisor — and a human reading the heartbeat dir — can see
+        which generation/world a lease belongs to after a shrink or a
+        spare reschedule."""
         self.path = path
         self.min_interval_s = float(min_interval_s)
         self.pid = os.getpid()
+        self.world_size = (int(world_size) if world_size is not None
+                           else None)
+        self.slot = int(slot) if slot is not None else None
         self.counters = {"writes": 0, "throttled": 0}
         self._last_write = None
         self._last_status = None
@@ -165,6 +209,10 @@ class HeartbeatFile:
             return
         record = {"pid": self.pid, "step": step, "phase": phase,
                   "status": status, "time": time.time()}
+        if self.world_size is not None:
+            record["world_size"] = self.world_size
+        if self.slot is not None:
+            record["slot"] = self.slot
         tmp = f"{self.path}.tmp.{self.pid}"
         try:
             with open(tmp, "w") as f:
@@ -226,15 +274,24 @@ class HeartbeatFile:
 
 
 class _Member:
-    """Supervisor-side view of one worker rank."""
+    """Supervisor-side view of one worker rank.
 
-    def __init__(self, rank: int, hb_path: str):
+    `rank` is the gang position (contiguous 0..n-1, re-assigned on a
+    shrink); `slot` is the physical placement identity (stable, never
+    reused — a rescheduled rank moves to a fresh spare slot and keeps
+    its rank id). `workdir` is the slot's private scratch directory."""
+
+    def __init__(self, rank: int, hb_path: str, slot: Optional[int] = None,
+                 workdir: Optional[str] = None):
         self.rank = rank
         self.hb_path = hb_path
+        self.slot = rank if slot is None else slot
+        self.workdir = workdir
         self.proc: Optional[subprocess.Popen] = None
         self.spawned_at = 0.0
         self.restarts = 0
         self.done = False
+        self.log_path: Optional[str] = None
 
     @property
     def alive(self) -> bool:
@@ -282,7 +339,19 @@ class ClusterSupervisor:
                  structural_check: Optional[Callable] = None,
                  env: Optional[dict] = None,
                  env_fn: Optional[Callable[[int], dict]] = None,
-                 log_dir: Optional[str] = None):
+                 log_dir: Optional[str] = None,
+                 spares: int = 0,
+                 allow_shrink: bool = False,
+                 min_workers: int = 1,
+                 per_rank_checkpoints: bool = False):
+        """Elastic knobs: `spares=N` holds N standby slots a
+        quarantined rank reschedules onto (fresh workdir, same rank,
+        budget reset); `allow_shrink=True` lets the gang relaunch at
+        reduced world size — never below `min_workers` — once spares
+        run out; `per_rank_checkpoints=True` switches the resume
+        handshake to the checkpoint_integrity divergence quorum over
+        `<checkpoint_dir>/rank-<r>/` directories (minority forks are
+        quarantined aside and healed before any rank resumes)."""
         self.nprocs = int(nprocs)
         self.command_fn = command_fn
         self.heartbeat_dir = heartbeat_dir
@@ -298,21 +367,51 @@ class ClusterSupervisor:
         self.env = env
         self.env_fn = env_fn
         self.log_dir = log_dir or heartbeat_dir
+        self.spares = max(0, int(spares))
+        self.allow_shrink = bool(allow_shrink)
+        self.min_workers = max(1, int(min_workers))
+        self.per_rank_checkpoints = bool(per_rank_checkpoints)
         os.makedirs(self.heartbeat_dir, exist_ok=True)
         os.makedirs(self.log_dir, exist_ok=True)
         self.members = [
-            _Member(r, heartbeat_path(heartbeat_dir, r))
+            _Member(r, heartbeat_path(heartbeat_dir, r), slot=r)
             for r in range(self.nprocs)]
+        # standby placement slots; slot ids continue past the primary
+        # ranks and are never reused, so the ledger reads unambiguously
+        self._spare_slots: List[int] = list(
+            range(self.nprocs, self.nprocs + self.spares))
         self.generation = 0
         self.gang_restarts = 0
+        self.shrinks = 0
+        self.spare_reschedules = 0
         self.quarantined: List[int] = []
+        self.quarantined_slots: List[int] = []
+        self.slot_ledger: List[dict] = []
         self.restart_ledger: List[dict] = []
         self.resume_steps: List[int] = []
+        self.quorum_reports: List[dict] = []
         self._t0 = time.monotonic()
+
+    # ------------------------------------------------------------ slots
+    def _slot_workdir(self, slot: int) -> str:
+        """The slot's private scratch directory (fresh for a spare —
+        a quarantined slot's disk contents are suspect)."""
+        path = os.path.join(self.log_dir, f"slot-{slot}")
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def _slot_event(self, event: str, m: _Member, **extra) -> None:
+        self.slot_ledger.append({
+            "event": event, "slot": m.slot, "rank": m.rank,
+            "gang_restart": self.gang_restarts,
+            "t_s": round(time.monotonic() - self._t0, 3), **extra})
 
     # ------------------------------------------------------------ spawn
     def _launch_gang(self, resume_step: int) -> None:
         port = free_port()
+        # the LIVE world size: shrink events become visible the moment
+        # the reduced gang launches
+        _obs.set_gauge("dl4j_cluster_world_size", self.nprocs)
         for m in self.members:
             # stale lease files from the previous generation must not
             # trip the new one before its first beat
@@ -321,14 +420,21 @@ class ClusterSupervisor:
             except OSError:
                 pass
             m.done = False
+            if m.workdir is None:
+                m.workdir = self._slot_workdir(m.slot)
             argv = list(self.command_fn(m.rank, self.nprocs, port,
                                         resume_step))
             env = dict(self.env if self.env is not None else os.environ)
+            # slot identity rides the environment (command_fn's
+            # signature stays the stable 4-arg contract)
+            env["DL4J_TPU_SLOT"] = str(m.slot)
+            env["DL4J_TPU_SLOT_DIR"] = m.workdir
             if self.env_fn is not None:
                 env.update(self.env_fn(m.rank) or {})
             log = os.path.join(
                 self.log_dir,
                 f"worker-{m.rank}.gen{self.generation}.log")
+            m.log_path = log
             with open(log, "ab") as logf:
                 m.proc = subprocess.Popen(
                     argv, env=env, stdout=logf,
@@ -444,48 +550,150 @@ class ClusterSupervisor:
         validation — every relaunched rank restores THIS step, so a
         rank whose filesystem view briefly lags can fail loudly instead
         of silently resuming elsewhere. 0 = no valid checkpoint, start
-        from scratch."""
+        from scratch.
+
+        With per_rank_checkpoints the scan becomes the divergence
+        quorum: the newest step a strict majority of rank copies agree
+        on (by state digest), minority/torn copies quarantined aside
+        and healed from the quorum copy BEFORE any rank resumes. An
+        unresolvable fork raises CheckpointDivergenceError out of
+        run() — fail loudly, never resume an arbitrary fork."""
         if not self.checkpoint_dir:
             return 0
+        if self.per_rank_checkpoints:
+            report = _ci.quorum_resume_step(self.checkpoint_dir,
+                                            self.nprocs)
+            if report is None:
+                return 0
+            self.quorum_reports.append(report)
+            if report["healed"]:
+                logger.warning(
+                    "cluster: divergence quorum healed rank(s) %s at "
+                    "step %d (quarantined: %s)", report["healed"],
+                    report["step"], report["quarantined"])
+            return int(report["step"])
         step = _ci.newest_valid_checkpoint(
             self.checkpoint_dir, structural_check=self.structural_check)
         return 0 if step is None else int(step)
+
+    # log-tail markers of a worker the jax distributed runtime tore
+    # down because a PEER died — collateral damage of the real fault,
+    # not evidence this host is bad
+    _COLLATERAL_MARKERS = (
+        b"JAX distributed service detected fatal errors",
+        b"Terminating process because the JAX distributed service",
+    )
+
+    def _is_collateral(self, m: _Member, reason: str) -> bool:
+        """True when the member's crash is the distributed runtime
+        reacting to ANOTHER member's death: the coordination-service
+        fatal marker in its log tail WITHOUT a Python traceback of its
+        own (a worker that crashed on its own error prints one before
+        the runtime tears it down). Collateral deaths are recorded in
+        the ledger but not charged against the restart budget —
+        otherwise one bad host would quarantine the whole gang."""
+        if reason != "crash" and not reason.startswith("killed:"):
+            return False
+        if not m.log_path:
+            return False
+        try:
+            with open(m.log_path, "rb") as f:
+                f.seek(max(0, os.path.getsize(m.log_path) - 16384))
+                tail = f.read()
+        except OSError:
+            return False
+        if b"Traceback (most recent call last)" in tail:
+            return False          # died on its own error: primary
+        return any(mk in tail for mk in self._COLLATERAL_MARKERS)
 
     def _record_faults(self, faults: List[Tuple[int, str]],
                        resume_step: int) -> None:
         self.gang_restarts += 1
         _obs.count("dl4j_cluster_gang_restarts_total")
+        collateral = {rank: self._is_collateral(self.members[rank],
+                                                reason)
+                      for rank, reason in faults}
+        if all(collateral.values()):
+            # someone died first even if the poll only saw the fallout:
+            # with no primary identifiable, charge everyone (bounded
+            # recovery beats an uncharged restart loop)
+            collateral = {rank: False for rank in collateral}
         for rank, reason in faults:
-            self.members[rank].restarts += 1
+            m = self.members[rank]
+            if not collateral[rank]:
+                m.restarts += 1
             self.restart_ledger.append({
                 "gang_restart": self.gang_restarts,
                 "worker": rank,
+                "slot": m.slot,
                 "reason": reason,
-                "worker_restarts": self.members[rank].restarts,
+                "collateral": collateral[rank],
+                "worker_restarts": m.restarts,
                 "resume_step": resume_step,
                 "t_s": round(time.monotonic() - self._t0, 3),
             })
             logger.warning(
-                "cluster: worker %d faulted (%s) — gang restart %d "
-                "from step %d", rank, reason, self.gang_restarts,
-                resume_step)
-        exhausted = [m.rank for m in self.members
+                "cluster: worker %d (slot %d) faulted (%s%s) — gang "
+                "restart %d from step %d", rank, m.slot, reason,
+                " [collateral]" if collateral[rank] else "",
+                self.gang_restarts, resume_step)
+        exhausted = [m for m in self.members
                      if m.restarts > self.max_restarts_per_worker]
-        if exhausted:
-            new = [r for r in exhausted if r not in self.quarantined]
-            self.quarantined.extend(new)
-            _obs.count("dl4j_cluster_quarantined_workers_total",
-                       n=len(new))
-            raise RestartsExhaustedError(
-                f"worker(s) {exhausted} exceeded "
-                f"max_restarts_per_worker={self.max_restarts_per_worker}"
-                f" — quarantined, gang aborted",
-                ledger=list(self.restart_ledger))
+        for m in exhausted:
+            self._retire_or_abort(m)
         if self.gang_restarts > self.max_gang_restarts:
             raise RestartsExhaustedError(
                 f"gang exceeded max_gang_restarts="
                 f"{self.max_gang_restarts}",
                 ledger=list(self.restart_ledger))
+
+    def _retire_or_abort(self, m: _Member) -> None:
+        """A member exhausted its restart budget: quarantine its slot,
+        then — in preference order — reschedule the rank onto a spare,
+        shrink the gang to fit, or abort with the full ledger."""
+        self.quarantined.append(m.rank)
+        self.quarantined_slots.append(m.slot)
+        self._slot_event("quarantined", m, restarts=m.restarts)
+        _obs.count("dl4j_cluster_quarantined_workers_total")
+        logger.warning("cluster: worker %d slot %d quarantined after "
+                       "%d restarts", m.rank, m.slot, m.restarts)
+        if self._spare_slots:
+            old_slot = m.slot
+            m.slot = self._spare_slots.pop(0)
+            m.workdir = self._slot_workdir(m.slot)   # fresh workdir
+            m.restarts = 0                           # fresh budget
+            self.spare_reschedules += 1
+            self._slot_event("rescheduled", m, from_slot=old_slot)
+            _obs.count("dl4j_cluster_spare_reschedules_total")
+            logger.warning(
+                "cluster: rank %d rescheduled from quarantined slot %d "
+                "onto spare slot %d (%d spare(s) left)", m.rank,
+                old_slot, m.slot, len(self._spare_slots))
+            return
+        # the spare pool is dry — this is the drillable juncture where
+        # elasticity either degrades (shrink) or gives up (abort)
+        _fire("dist.spare_exhausted")
+        if self.allow_shrink and len(self.members) - 1 >= self.min_workers:
+            self._slot_event("retired_shrink", m)
+            self.members.remove(m)
+            for i, survivor in enumerate(self.members):
+                survivor.rank = i
+                survivor.hb_path = heartbeat_path(self.heartbeat_dir, i)
+            self.nprocs = len(self.members)
+            self.shrinks += 1
+            _obs.count("dl4j_cluster_shrinks_total")
+            logger.warning(
+                "cluster: no spare left — shrinking the gang to "
+                "world size %d (floor min_workers=%d)", self.nprocs,
+                self.min_workers)
+            return
+        raise RestartsExhaustedError(
+            f"worker(s) {[m.rank]} exceeded "
+            f"max_restarts_per_worker={self.max_restarts_per_worker} "
+            f"— quarantined, no spare left and shrink "
+            f"{'would go below min_workers' if self.allow_shrink else 'disallowed'}"
+            f", gang aborted",
+            ledger=list(self.restart_ledger))
 
     # --------------------------------------------------------------- run
     def run(self, timeout_s: Optional[float] = None) -> dict:
@@ -520,13 +728,21 @@ class ClusterSupervisor:
     def stats(self) -> dict:
         out = {
             "nprocs": self.nprocs,
+            "world_size": self.nprocs,
             "generations": self.generation,
             "gang_restarts": self.gang_restarts,
             "max_restarts_per_worker": self.max_restarts_per_worker,
             "per_worker_restarts": {
                 m.rank: m.restarts for m in self.members if m.restarts},
             "quarantined": list(self.quarantined),
+            "quarantined_slots": list(self.quarantined_slots),
+            "spares_left": len(self._spare_slots),
+            "spare_reschedules": self.spare_reschedules,
+            "shrinks": self.shrinks,
+            "slots": {m.rank: m.slot for m in self.members},
+            "slot_ledger": [dict(e) for e in self.slot_ledger],
             "resume_steps": list(self.resume_steps),
+            "quorum_reports": [dict(q) for q in self.quorum_reports],
             "ledger": [dict(e) for e in self.restart_ledger],
         }
         fleet = self.fleet_metrics()

@@ -88,6 +88,7 @@ REGISTERED_POINTS = frozenset({
     "checkpoint.write",
     "data.next",
     "dist.heartbeat_stale",
+    "dist.spare_exhausted",
     "inference.batch",
     "inference.complete",
     "obs.emit",

@@ -74,6 +74,9 @@ def main():
     ap.add_argument("--heartbeat-dir", default="")
     ap.add_argument("--resume-step", type=int, default=-1)
     ap.add_argument("--hang-timeout", type=float, default=0.0)
+    # per-rank checkpoint copies: every rank writes its own
+    # rank-<r>/ checkpoint dir — the divergence-quorum drill input
+    ap.add_argument("--per-rank-ckpt", action="store_true")
     ap.add_argument("--guard", default="",
                     choices=("", "abort"))
     # per-step host-side sleep: widens the mid-step window so an
@@ -101,9 +104,14 @@ def main():
         )
         from deeplearning4j_tpu.resilience.supervisor import StepWatchdog
 
+        # the elastic identity rides the lease: world size from the
+        # launch arguments, slot from the supervisor's environment
+        slot = os.environ.get("DL4J_TPU_SLOT")
         hb = HeartbeatFile(
             heartbeat_path(args.heartbeat_dir or args.out_dir,
-                           args.pid))
+                           args.pid),
+            world_size=args.nprocs,
+            slot=int(slot) if slot else None)
         # hang-timeout 0 = lease emission only (the EXTERNAL stale-lease
         # kill is the recovery path); > 0 additionally arms the
         # watchdog's SIGUSR1-then-hard-exit escalation
@@ -122,7 +130,8 @@ def main():
         checkpoint_every=args.checkpoint_every,
         averaging_frequency=args.averaging_frequency,
         threshold_compression=args.threshold_compression,
-        watchdog=wd, guard=guard)
+        watchdog=wd, guard=guard,
+        per_rank_checkpoints=args.per_rank_ckpt)
 
     def batch_fn(step):
         if args.spin_ms > 0:
@@ -157,7 +166,21 @@ def main():
             tm.fit(batch_fn, steps, start_step=start)
         except NonFiniteLossError:
             hb.mark("nan_abort")
-            sys.exit(EXIT_NAN)
+            os._exit(EXIT_NAN)
+        except BaseException:   # noqa: BLE001 - gang member fail-fast
+            # a cluster worker converts ANY fatal error into a PROMPT
+            # nonzero exit: sys.exit would run jax.distributed's
+            # atexit barrier, wedging this process against its dead/
+            # dying peers until the lease times out — os._exit lets
+            # the external supervisor classify a crash in one poll
+            # and reschedule instead of waiting out a stale lease
+            import traceback
+
+            traceback.print_exc()
+            sys.stdout.flush()
+            sys.stderr.flush()
+            hb.mark("crash")
+            os._exit(1)
         hb.mark("done")
     else:
         tm.fit(batch_fn, steps)
@@ -187,7 +210,10 @@ def main():
                   for l in jax.tree_util.tree_leaves(net.params)]
         extras = {"score": float(net.score()),
                   "iteration": net.iteration,
-                  "restarts": restarts}
+                  "restarts": restarts,
+                  # the live world this run actually trained in — the
+                  # shrink drill asserts the dp denominator followed it
+                  "world": args.nprocs}
         if args.threshold_compression > 0.0:
             wire = tm.training_stats()["wire"]
             extras["wire_ratio"] = wire["compression_ratio"]

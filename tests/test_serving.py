@@ -155,3 +155,172 @@ def test_model_server_error_paths(class_index):
         assert hei.value.code == 404
     finally:
         server.stop()
+
+
+# ===================================== binary wire format (npz bytes)
+def test_binary_wire_round_trip_and_encoding():
+    """Satellite: ModelClient.predict speaks raw npz bytes by default —
+    inputs ship as array bytes (no .tolist() materialization), outputs
+    come back as host numpy arrays, and the values match both the JSON
+    wire and a direct net.output call."""
+    from deeplearning4j_tpu.parallel.serving import (
+        NPZ_CONTENT_TYPE,
+        ModelClient,
+        ModelServer,
+        decode_npz_request,
+        decode_npz_response,
+        encode_npz_request,
+        encode_npz_response,
+    )
+
+    # pure codec round trip (no server): arrays + meta survive intact
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    req = decode_npz_request(encode_npz_request(x, {"tenant": "gold"}))
+    np.testing.assert_array_equal(req["inputs"], x)
+    assert req["tenant"] == "gold"
+    multi = decode_npz_request(
+        encode_npz_request({"a": x, "b": x + 1}, {}))
+    assert set(multi["inputs"]) == {"a", "b"}
+    resp = decode_npz_response(
+        encode_npz_response([x, x * 2], {"model": "m", "version": "v"}))
+    assert isinstance(resp["outputs"], list) and len(resp["outputs"]) == 2
+    np.testing.assert_array_equal(resp["outputs"][1], x * 2)
+    assert resp["model"] == "m"
+    assert NPZ_CONTENT_TYPE == "application/x-npz"
+
+    net = _net()
+    rng = np.random.default_rng(3)
+    xs = rng.normal(size=(4, 8)).astype(np.float32)
+    server = ModelServer(net).start()
+    try:
+        url = f"http://127.0.0.1:{server.port}"
+        r_bin = ModelClient(url, breaker=None).predict(xs)
+        assert isinstance(r_bin["outputs"], np.ndarray)
+        r_json = ModelClient(url, breaker=None, wire="json").predict(xs)
+        assert isinstance(r_json["outputs"], list)
+        direct = np.asarray(net.output(xs))
+        np.testing.assert_allclose(r_bin["outputs"], direct,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(r_json["outputs"], np.float32), direct,
+            rtol=1e-4, atol=1e-5)
+        assert r_bin["model"] == r_json["model"] == "default"
+    finally:
+        server.stop()
+
+
+def test_binary_wire_decode_top_rides_meta(class_index):
+    """decode_top over the binary wire: decoded rows come back in the
+    npz __meta__ JSON, outputs stay arrays."""
+    from deeplearning4j_tpu.parallel.serving import ModelClient, ModelServer
+
+    net = _net()
+    x = np.random.default_rng(1).normal(size=(2, 8)).astype(np.float32)
+    server = ModelServer(net, labels=ImageNetLabels(class_index)).start()
+    try:
+        client = ModelClient(f"http://127.0.0.1:{server.port}",
+                             breaker=None)
+        resp = client.predict(x, decode_top=2)
+        assert isinstance(resp["outputs"], np.ndarray)
+        assert len(resp["decoded"]) == 2
+        direct = np.asarray(net.output(x))
+        assert resp["decoded"][0][0]["class"] == int(np.argmax(direct[0]))
+    finally:
+        server.stop()
+
+
+def test_binary_wire_falls_back_to_json_for_old_servers():
+    """Satellite: the FIRST bounce off a JSON-only server (400
+    'malformed JSON body' on the binary bytes) permanently flips the
+    client to the legacy JSON wire; genuine application errors never
+    trigger the fallback."""
+    import http.server
+    import socketserver
+    import threading as _threading
+
+    from deeplearning4j_tpu.parallel.serving import ModelClient
+    from deeplearning4j_tpu.resilience import Retry, ServingError
+
+    hits = []
+
+    class OldHandler(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            raw = self.rfile.read(n)
+            hits.append(self.headers.get("Content-Type"))
+            try:
+                json.loads(raw.decode())
+                body, code = b'{"outputs": [[1.0]]}', 200
+            except Exception as e:   # noqa: BLE001 - the old-server shape
+                body = json.dumps(
+                    {"error": f"malformed JSON body: {e}",
+                     "error_class": "_ClientError"}).encode()
+                code = 400
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    class _S(socketserver.ThreadingMixIn, http.server.HTTPServer):
+        daemon_threads = True
+
+    httpd = _S(("127.0.0.1", 0), OldHandler)
+    _threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        client = ModelClient(url, breaker=None,
+                             retry=Retry(max_attempts=1))
+        out = client.predict([[1.0]])
+        assert out["outputs"] == [[1.0]]
+        assert not client._npz_ok          # flipped to JSON for good
+        assert hits == ["application/x-npz", "application/json"]
+        client.predict([[1.0]])            # straight JSON now
+        assert hits[-1] == "application/json" and len(hits) == 3
+        # wire="npz" never falls back: the bounce surfaces typed
+        strict = ModelClient(url, breaker=None, wire="npz",
+                             retry=Retry(max_attempts=1))
+        with pytest.raises(ServingError) as ei:
+            strict.predict([[1.0]])
+        assert ei.value.status == 400 and strict._npz_ok
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_binary_wire_multi_input_dict(class_index):
+    """Multi-input dict requests ride the binary wire as one npz entry
+    per named stream (input:<name>) and reassemble server-side in
+    network_inputs order."""
+    from deeplearning4j_tpu import ComputationGraph
+    from deeplearning4j_tpu.parallel.serving import ModelClient, ModelServer
+
+    conf = (NeuralNetConfiguration.Builder().seed(5).updater("sgd")
+            .learning_rate(0.1).activation("tanh").weight_init("xavier")
+            .graph_builder()
+            .add_inputs("a", "b")
+            .set_input_types(a=InputType.feed_forward(3),
+                             b=InputType.feed_forward(5))
+            .add_layer("da", DenseLayer(n_out=4), "a")
+            .add_layer("db", DenseLayer(n_out=4), "b")
+            .add_layer("out", OutputLayer(n_out=3, loss="mcxent"),
+                       "da", "db")
+            .set_outputs("out").build())
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(5)
+    a = rng.normal(size=(2, 3)).astype(np.float32)
+    b = rng.normal(size=(2, 5)).astype(np.float32)
+    server = ModelServer(net).start()
+    try:
+        client = ModelClient(f"http://127.0.0.1:{server.port}",
+                             breaker=None)
+        r = client.predict({"a": a, "b": b})
+        assert isinstance(r["outputs"], np.ndarray)
+        np.testing.assert_allclose(
+            r["outputs"], np.asarray(net.output(a, b)),
+            rtol=1e-4, atol=1e-5)
+    finally:
+        server.stop()

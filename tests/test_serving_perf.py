@@ -332,6 +332,100 @@ def test_pipelined_throughput_beats_blocking_dispatch():
         f"{blocking:.0f} req/s")
 
 
+# ===================================== priority-aware queue ordering
+def test_request_queue_priority_ordering_unit():
+    """Satellite (ROADMAP item 4 ordering gap): the bounded request
+    queue dequeues high-before-normal-before-low, FIFO within one
+    class — and stays a real queue.Queue (bounded put_nowait raises
+    Full, qsize/empty consistent)."""
+    import queue as _q
+
+    from deeplearning4j_tpu.parallel.inference import (
+        _Pending,
+        _RequestQueue,
+    )
+
+    rq = _RequestQueue(maxsize=6)
+
+    def pend(pri, tag):
+        return _Pending((np.full((1, 2), tag, np.float32),),
+                        priority_idx=pri)
+
+    for pri, tag in ((2, 1), (2, 2), (1, 3), (0, 4), (1, 5), (0, 6)):
+        rq.put_nowait(pend(pri, tag))
+    assert rq.qsize() == 6
+    with pytest.raises(_q.Full):
+        rq.put_nowait(pend(1, 7))
+    got = [float(rq.get_nowait().xs[0][0, 0]) for _ in range(6)]
+    # highs (4, 6) first in arrival order, then normals (3, 5),
+    # then lows (1, 2)
+    assert got == [4.0, 6.0, 3.0, 5.0, 1.0, 2.0]
+    assert rq.empty()
+    with pytest.raises(_q.Empty):
+        rq.get_nowait()
+
+
+class _GateNet:
+    """Blocks every output() until `gate` opens; records the tag (first
+    element) of each dispatched batch — the dequeue-order probe."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.seen = []
+
+    def output(self, x):
+        x = np.asarray(x)
+        self.seen.append(float(x[0, 0]))
+        assert self.gate.wait(timeout=10.0), "gate never opened"
+        return x
+
+
+def test_priority_dequeue_under_deep_queue():
+    """Satellite acceptance (deep-queue pin): with the batcher stalled
+    on an in-flight batch, a deep queue of admitted low/normal
+    requests does NOT delay a later-admitted high request — on resume
+    the highs dispatch first, then normals, then lows."""
+    net = _GateNet()
+    pi = ParallelInference(net, batch_limit=1, queue_limit=16,
+                           warmup=False, pipeline_depth=0,
+                           max_wait_ms=0.0, adaptive_wait=False)
+    try:
+        results = {}
+
+        def call(tag, priority):
+            def run():
+                out = pi.output(np.full((1, 2), tag, np.float32),
+                                priority=priority, timeout_s=30.0)
+                results[tag] = np.asarray(out)[0, 0]
+
+            t = threading.Thread(target=run, daemon=True,
+                                 name=f"req-{tag}")
+            t.start()
+            return t
+
+        threads = [call(0.5, "normal")]          # the stall filler
+        while not net.seen:                      # batcher holds it
+            time.sleep(0.005)
+        # deep queue builds while the batcher is stalled: lows and
+        # normals FIRST, highs admitted LAST
+        order = [(1, "low"), (2, "low"), (3, "normal"), (4, "low"),
+                 (5, "normal"), (6, "high"), (7, "high")]
+        for tag, pri in order:
+            threads.append(call(float(tag), pri))
+            while pi.queue_depth() < len(threads) - 1:
+                time.sleep(0.005)
+        net.gate.set()                           # resume the batcher
+        for t in threads:
+            t.join(timeout=20.0)
+            assert not t.is_alive()
+        # dispatch order: filler, then strict class order
+        assert net.seen[0] == 0.5
+        assert net.seen[1:] == [6.0, 7.0, 3.0, 5.0, 1.0, 2.0, 4.0]
+        assert set(results) == {0.5} | {float(t) for t, _ in order}
+    finally:
+        pi.shutdown()
+
+
 # ======================================== /status surfacing contract
 def test_status_surfaces_pipeline_and_trace_counters():
     from deeplearning4j_tpu.parallel.serving import (

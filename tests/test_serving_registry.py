@@ -289,16 +289,18 @@ def test_admission_quota_over_http_and_retry_after():
     try:
         client = _no_retry_client(server.port)
         x = [[1.0, 2.0]]
-        assert client.predict(x, tenant="burst2")["outputs"]
-        assert client.predict(x, tenant="burst2")["outputs"]
+        # binary wire: outputs come back as numpy arrays, so assert on
+        # size rather than (ambiguous) array truthiness
+        assert np.asarray(client.predict(x, tenant="burst2")["outputs"]).size
+        assert np.asarray(client.predict(x, tenant="burst2")["outputs"]).size
         with pytest.raises(ServingError) as ei:
             client.predict(x, tenant="burst2")
         assert ei.value.status == 429
         assert ei.value.error_class == "QuotaExceededError"
         assert ei.value.retry_after_s >= 1
         # vip is unmetered; unknown tenants fall back to default
-        assert client.predict(x, tenant="vip")["outputs"]
-        assert client.predict(x)["outputs"]
+        assert np.asarray(client.predict(x, tenant="vip")["outputs"]).size
+        assert np.asarray(client.predict(x)["outputs"]).size
         st = client.status()
         assert st["admission"]["shed_quota"] == 1
     finally:
@@ -348,9 +350,20 @@ def test_overload_sheds_mostly_lowest_class():
         server.stop()
 
     assert counts["gold"]["ok"] > 0 and counts["bronze"]["shed"] > 0
-    # lowest class absorbs the most shedding, highest the least
-    assert counts["bronze"]["shed"] >= counts["silver"]["shed"] \
-        >= counts["gold"]["shed"]
+
+    def shed_rate(tenant):
+        total = counts[tenant]["ok"] + counts[tenant]["shed"]
+        return counts[tenant]["shed"] / max(1, total)
+
+    # lowest class absorbs the highest shed FRACTION, highest the
+    # least. (Per-attempt rates, not absolute counts: with PR 10's
+    # priority-aware dequeue an admitted bronze request also WAITS
+    # longest, so these closed-loop generators attempt bronze less
+    # often and absolute counts no longer order reliably — the
+    # admission thresholds order the per-attempt probability by
+    # construction.)
+    assert shed_rate("bronze") >= shed_rate("silver") \
+        >= shed_rate("gold")
 
 
 # ===================================================== replica router

@@ -347,7 +347,87 @@ def test_long_held_lock_flagged():
 
 
 @pytest.mark.usefixtures("_no_session_sanitizer")
+def test_queue_handoff_cycle_detected():
+    """Satellite (queue.Queue ordering in the cross-thread graph): the
+    classic coupled-queue deadlock — producer holds L blocking-put on
+    a BOUNDED queue, the consumer that drains it takes L to process
+    the item — surfaces as the cycle L -> Q -> L even on a run whose
+    interleaving never wedged (the drill runs the threads
+    sequentially, so the test itself can never deadlock)."""
+    import queue
+
+    san = LockOrderSanitizer(long_hold_s=30.0).install()
+    try:
+        q = queue.Queue(maxsize=4)
+        lock = threading.Lock()
+
+        def producer():
+            with lock:
+                q.put("item")        # bounded blocking put under L
+
+        def consumer():
+            q.get()                  # handoff window opens
+            with lock:               # processing the item needs L
+                pass
+
+        for fn, name in ((producer, "q-prod"), (consumer, "q-cons")):
+            t = threading.Thread(target=fn, name=name, daemon=True)
+            t.start()
+            t.join(timeout=10.0)
+            assert not t.is_alive()
+        cycles = san.cycles()
+        assert cycles, f"no cycle; edges={san.edges()}"
+        sites = {s for c in cycles for s in c}
+        assert any(s.startswith("q:") for s in sites), sites
+        assert any(v["rule"] == "san-lock-order-cycle"
+                   for v in san.violations())
+    finally:
+        san.uninstall()
+
+
+@pytest.mark.usefixtures("_no_session_sanitizer")
+def test_queue_nonblocking_and_unbounded_ops_make_no_producer_edge():
+    """False-positive guards: an UNBOUNDED blocking put cannot wedge
+    (no producer edge, so the same handoff pattern is not a cycle),
+    and put_nowait/get_nowait never participate at all."""
+    import queue
+
+    san = LockOrderSanitizer().install()
+    try:
+        lock = threading.Lock()
+        q_unbounded = queue.Queue()
+
+        def producer():
+            with lock:
+                q_unbounded.put("x")
+
+        def consumer():
+            q_unbounded.get()
+            with lock:
+                pass
+
+        for fn in (producer, consumer):
+            t = threading.Thread(target=fn, name="q-fp", daemon=True)
+            t.start()
+            t.join(timeout=10.0)
+        assert san.cycles() == []
+
+        san.reset()
+        q_bounded = queue.Queue(maxsize=2)
+        with lock:
+            q_bounded.put_nowait(1)      # non-blocking: no edge
+        q_bounded.get_nowait()
+        assert all(not e.src.startswith("q:")
+                   and not e.dst.startswith("q:")
+                   for e in san.edges())
+    finally:
+        san.uninstall()
+
+
+@pytest.mark.usefixtures("_no_session_sanitizer")
 def test_uninstall_restores_real_locks():
+    import queue
+
     before = threading.Lock
     san = LockOrderSanitizer().install()
     assert threading.Lock is not before
@@ -355,6 +435,12 @@ def test_uninstall_restores_real_locks():
     assert threading.Lock is sanitizers._REAL_LOCK
     assert threading.RLock is sanitizers._REAL_RLOCK
     assert sanitizers.active_sanitizer() is None
+    # queue.Queue methods restored too (no tracking attribute)
+    assert queue.Queue.put is sanitizers._REAL_Q_PUT
+    assert queue.Queue.get is sanitizers._REAL_Q_GET
+    q = queue.Queue(maxsize=1)
+    q.put(1)
+    assert q.get() == 1 and not hasattr(q, "_san_site")
 
 
 @pytest.mark.usefixtures("_no_session_sanitizer")
