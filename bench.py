@@ -69,9 +69,14 @@ from deeplearning4j_tpu.observability.perf import (  # noqa: E402
 )
 
 
-def bench_resnet50(batch=128, hw=224, iters=32, unroll=4,
-                   compute_dtype="bfloat16", bn_stat_sample=1):
-    """Steady-state training-step throughput, batch resident on device.
+def make_flagship_program(batch=128, hw=224, n_classes=1000, unroll=4,
+                          compute_dtype="bfloat16", helpers="fused",
+                          bn_stat_sample=1):
+    """Build the flagship k-step train program WITHOUT compiling it:
+    (jit_k, example_args, net, x). The bench AOT-compiles and times it;
+    `dl4j-analyze --programs` lowers a reduced-dims instance and lints
+    the jaxpr dtypes + alias map against the flagship's declared bf16
+    policy (the compile takes minutes on CPU, the lowering seconds).
 
     Runs the fused helper tier (nn/helpers) and `unroll` grad-over-flat
     train steps per dispatch — the shape of a real training loop, which
@@ -85,13 +90,15 @@ def bench_resnet50(batch=128, hw=224, iters=32, unroll=4,
 
     from __graft_entry__ import _flagship
 
-    net, _, _ = _flagship(batch=batch, hw=hw, compute_dtype=compute_dtype,
-                          helpers="fused", bn_stat_sample=bn_stat_sample)
+    net, _, _ = _flagship(batch=batch, hw=hw, n_classes=n_classes,
+                          compute_dtype=compute_dtype,
+                          helpers=helpers, bn_stat_sample=bn_stat_sample)
     rng = np.random.default_rng(0)
     x = jax.device_put(jnp.asarray(
         rng.normal(size=(batch, hw, hw, 3)).astype(np.float32)))
     y = jax.device_put(jnp.asarray(
-        np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)]))
+        np.eye(n_classes, dtype=np.float32)[
+            rng.integers(0, n_classes, batch)]))
     _ = float(jnp.sum(x[0, 0, 0]))   # force staging complete
 
     chain = net._flat_chain_obj()
@@ -106,7 +113,8 @@ def bench_resnet50(batch=128, hw=224, iters=32, unroll=4,
         def loss_flat(fl):
             params = cast_floating(chain.unravel(fl), cd)
             loss, (ns, _) = net._loss_fn(
-                params, states, {"input": x.astype(cd)}, [y], None, None,
+                params, states, {"input": x.astype(cd) if cd is not None
+                                 else x}, [y], None, None,
                 None, rnn_carries=None)
             return loss.astype(net.dtype), ns
 
@@ -124,15 +132,28 @@ def bench_resnet50(batch=128, hw=224, iters=32, unroll=4,
 
     flat = chain.ravel(net.params)
     uflat = chain.ravel_upd(net.updater_states)
-    states = net.states
+    jit_k = functools.partial(jax.jit, donate_argnums=(0, 1, 2))(
+        k_steps_fn)
     step0 = jnp.asarray(0, jnp.int32)
+    return jit_k, (flat, uflat, net.states, step0), net, x
+
+
+def bench_resnet50(batch=128, hw=224, iters=32, unroll=4,
+                   compute_dtype="bfloat16", bn_stat_sample=1):
+    """Steady-state training-step throughput, batch resident on device
+    (the program built by `make_flagship_program`, AOT-compiled)."""
+    import jax
+    import jax.numpy as jnp
+
+    jit_k, args, net, x = make_flagship_program(
+        batch=batch, hw=hw, unroll=unroll, compute_dtype=compute_dtype,
+        bn_stat_sample=bn_stat_sample)
+    flat, uflat, states, step0 = args
     # AOT path (lower -> compile -> call): ONE compile serves both the
     # bench loop and the XLA cost analysis — the per-program flops /
     # bytes-accessed the CostModel turns into exact MFU, replacing the
     # hand-derived flops constant as the headline (legacy `approx_mfu`
     # still emitted for trajectory comparability).
-    jit_k = functools.partial(jax.jit, donate_argnums=(0, 1, 2))(
-        k_steps_fn)
     compiled = jit_k.lower(flat, uflat, states, step0).compile()
     cost_model = CostModel(device=jax.devices()[0])
     try:

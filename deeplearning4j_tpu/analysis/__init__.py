@@ -1,22 +1,30 @@
 """dl4j-analyze: static invariant checker + runtime sanitizers.
 
 Three static passes (AST-only — analyzed code is parsed, never
-imported) plus an opt-in runtime lock-order sanitizer:
+imported), a compiled-program pass (jaxpr/HLO — the one pass that
+imports jax, only when invoked), plus an opt-in runtime lock-order
+sanitizer:
 
   jit          recompile hygiene on the step/serving hot paths
   concurrency  thread/lock discipline + the thread/lock catalog
-  conformance  fault-point / metric registries, swallow discipline,
-               test coverage of registered names
+  conformance  fault-point / metric / program-rule registries,
+               swallow discipline, test coverage of registered names
+  programs     compiled-program lint below the AST: declared precision
+               policy vs jaxpr dtypes, donation vs the executable's
+               alias map, transpose churn, hidden host transfers,
+               dead outputs, serving bucket fill (program_lint.py,
+               `--programs` mode)
 
 Entry points:
 
   python tools/analyze.py            # full run vs the baseline
   python tools/analyze.py --diff     # changed files only
   python tools/analyze.py --rules    # the rule catalog
+  python tools/analyze.py --programs # compiled-program lint (jax, CPU)
   DL4J_TPU_SANITIZE=locks pytest …   # runtime lock-order sanitizer
 
-This package deliberately avoids importing jax or any sibling
-subsystem so the analyzer runs in a bare interpreter.
+Module scope stays import-light everywhere (program_lint included) so
+the default analyzer still runs in a bare interpreter without jax.
 """
 
 from deeplearning4j_tpu.analysis.findings import (  # noqa: F401
@@ -24,6 +32,11 @@ from deeplearning4j_tpu.analysis.findings import (  # noqa: F401
     Baseline,
     Finding,
     Rule,
+)
+from deeplearning4j_tpu.analysis.program_lint import (  # noqa: F401
+    REGISTERED_PROGRAM_RULES,
+    ProgramRecord,
+    Thresholds,
 )
 from deeplearning4j_tpu.analysis.runner import (  # noqa: F401
     AnalysisResult,
@@ -39,5 +52,6 @@ from deeplearning4j_tpu.analysis.sanitizers import (  # noqa: F401
 __all__ = [
     "RULES", "Rule", "Finding", "Baseline", "AnalysisResult",
     "analyze", "main", "LockOrderSanitizer", "active_sanitizer",
-    "install_from_env",
+    "install_from_env", "ProgramRecord", "Thresholds",
+    "REGISTERED_PROGRAM_RULES",
 ]

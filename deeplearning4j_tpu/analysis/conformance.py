@@ -13,11 +13,18 @@ is a single source of truth:
   reg-swallowed-exception       `except Exception: pass` outside the
                                 guarded-telemetry annotation discipline
   reg-untested-registry-name    registered name no test ever mentions
+  reg-unregistered-program-rule Rule("prog-...") catalog entry not in
+                                the pinned REGISTERED_PROGRAM_RULES
+  reg-unimplemented-program-rule pinned program rule with no Rule(...)
+                                catalog definition
 
 The registries themselves are read from the *AST* of the modules that
 define them (frozenset literals assigned to REGISTERED_POINTS /
-REGISTERED_METRICS / DERIVED_METRICS), so this pass — like the other
-two — never imports the analyzed code.
+REGISTERED_METRICS / DERIVED_METRICS / REGISTERED_PROGRAM_RULES), so
+this pass — like the other two — never imports the analyzed code. The
+program-rule pin mirrors the metric discipline: the `prog-*` ids in
+the findings.py catalog and the registry in program_lint.py must move
+in the same commit, and every pinned id must be named by a test.
 """
 
 from __future__ import annotations
@@ -54,6 +61,8 @@ class RegistryView:
     metrics: Set[str] = field(default_factory=set)
     metrics_site: Tuple[str, int] = ("", 0)
     derived: Set[str] = field(default_factory=set)
+    program_rules: Set[str] = field(default_factory=set)
+    program_rules_site: Tuple[str, int] = ("", 0)
 
     @property
     def complete(self) -> bool:
@@ -73,7 +82,8 @@ def parse_registries(sources: List[SourceFile]) -> RegistryView:
                     continue
                 if t.id not in ("REGISTERED_POINTS",
                                 "REGISTERED_METRICS",
-                                "DERIVED_METRICS"):
+                                "DERIVED_METRICS",
+                                "REGISTERED_PROGRAM_RULES"):
                     continue
                 names = _literal_names(node.value)
                 if names is None:
@@ -84,9 +94,27 @@ def parse_registries(sources: List[SourceFile]) -> RegistryView:
                 elif t.id == "REGISTERED_METRICS":
                     view.metrics = names
                     view.metrics_site = (sf.rel, node.lineno)
+                elif t.id == "REGISTERED_PROGRAM_RULES":
+                    view.program_rules = names
+                    view.program_rules_site = (sf.rel, node.lineno)
                 else:
                     view.derived = names
     return view
+
+
+def program_rule_sites(sources: List[SourceFile]
+                       ) -> List[Tuple[str, SourceFile, int]]:
+    """Every `Rule("prog-...", ...)` catalog definition in the
+    analyzed sources (the findings.py catalog, read as AST)."""
+    out = []
+    for sf in sources:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) \
+                    and call_name(node) == "Rule" and node.args:
+                lit = const_str(node.args[0])
+                if lit is not None and lit.startswith("prog-"):
+                    out.append((lit, sf, node.lineno))
+    return out
 
 
 def _literal_names(value) -> Optional[Set[str]]:
@@ -242,6 +270,27 @@ def run(sources: List[SourceFile],
                 f'neither a registered metric nor a registered-name '
                 f'prefix'))
 
+    # ---- program-rule registry pin -----------------------------------
+    rule_sites = program_rule_sites(sources)
+    if view.program_rules:
+        for name, sf, line in sorted(rule_sites):
+            if name in view.program_rules:
+                continue
+            if pragma_allows(sf.allow, line,
+                             "reg-unregistered-program-rule"):
+                continue
+            findings.append(Finding(
+                "reg-unregistered-program-rule", sf.rel, line,
+                f'Rule("{name}") is not listed in '
+                f"REGISTERED_PROGRAM_RULES"))
+        declared = {n for n, _, _ in rule_sites}
+        for name in sorted(view.program_rules - declared):
+            findings.append(Finding(
+                "reg-unimplemented-program-rule",
+                view.program_rules_site[0], view.program_rules_site[1],
+                f'pinned program rule "{name}" has no Rule(...) '
+                f"catalog definition"))
+
     # ---- exception swallows ------------------------------------------
     findings.extend(swallow_sites(sources))
 
@@ -262,6 +311,13 @@ def run(sources: List[SourceFile],
                     "reg-untested-registry-name", view.metrics_site[0],
                     view.metrics_site[1],
                     f'metric "{name}" is named by no test'))
+        for name in sorted(view.program_rules):
+            if name not in blob:
+                findings.append(Finding(
+                    "reg-untested-registry-name",
+                    view.program_rules_site[0],
+                    view.program_rules_site[1],
+                    f'program rule "{name}" is named by no test'))
     return findings
 
 

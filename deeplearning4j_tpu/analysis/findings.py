@@ -32,7 +32,8 @@ PRAGMA_RE = re.compile(r"#\s*analyze:\s*allow=([a-z0-9,\-]+)")
 @dataclass(frozen=True)
 class Rule:
     id: str
-    pass_name: str          # "jit" | "concurrency" | "conformance" | "runtime"
+    pass_name: str          # "jit" | "concurrency" | "conformance"
+                            # | "program" | "runtime"
     description: str
 
 
@@ -83,6 +84,36 @@ _RULE_LIST = [
          "guarded-telemetry annotation — silent failure swallowing"),
     Rule("reg-untested-registry-name", "conformance",
          "registered fault point or metric name not named by any test"),
+    Rule("reg-unregistered-program-rule", "conformance",
+         'Rule("prog-...") in the catalog not listed in the pinned '
+         "REGISTERED_PROGRAM_RULES registry (analysis/program_lint.py)"),
+    Rule("reg-unimplemented-program-rule", "conformance",
+         "REGISTERED_PROGRAM_RULES entry with no Rule(...) catalog "
+         "definition — a pinned program rule nothing implements"),
+    # ---- pass 4: compiled-program lint (jaxpr / lowered / compiled HLO) ----
+    Rule("prog-fp32-matmul-under-policy", "program",
+         "dot_general/conv op computing in f32 inside a program whose "
+         "declared precision_policy is bf16/f16 — the matmul units run "
+         "at half throughput and the policy is silently violated"),
+    Rule("prog-unhonored-donation", "program",
+         "argument marked in donate_argnums but absent from the "
+         "executable's input-output alias map — the caller loses the "
+         "buffer AND pays the copy (silent 2x memory)"),
+    Rule("prog-transpose-churn", "program",
+         "transpose/copy op bytes above threshold in the compiled "
+         "program — NHWC<->NCHW (or batch<->time major) layout thrash "
+         "burning memory bandwidth the roofline charges to the model"),
+    Rule("prog-hidden-host-transfer", "program",
+         "outfeed/infeed/host-callback edge inside a hot compiled "
+         "program — every call blocks the device on the host"),
+    Rule("prog-dead-output", "program",
+         "computed program output no caller consumes — the program "
+         "pays flops and a device->host edge for a value that is "
+         "dropped on the floor"),
+    Rule("prog-excess-padding", "program",
+         "serving pow2 bucket fill ratio below threshold — most of "
+         "every dispatched batch is padding, so the MXU runs mostly "
+         "dead rows"),
     # ---- runtime sanitizers (DL4J_TPU_SANITIZE=locks) ----
     Rule("san-lock-order-cycle", "runtime",
          "cyclic lock-acquisition order observed across threads — a "

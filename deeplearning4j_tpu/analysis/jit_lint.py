@@ -18,6 +18,12 @@ gap, closed by the engine's stable entry points):
     `threading.Thread` target, and the engine's StepProgram/StepHarness
     entry points by exact qualname (`ROOT_QUALNAMES`) — the compiled
     step path hangs off those whatever the surrounding loop is named;
+  - jit sites include every spelling in the tree: `jax.jit(f, ...)`,
+    `@jax.jit`, `@partial(jax.jit, ...)` (plain or
+    functools-qualified), the chained `functools.partial(jax.jit,
+    ...)(f)` call, and module-level aliases
+    `jit = functools.partial(jax.jit, ...)` whose call/decorator
+    sites inherit the partial's donate/static kwargs;
   - `self.m()` edges resolve through a class-hierarchy map (the class,
     its ancestors, and its descendants by base-name linking — virtual
     dispatch included) to the actual method bodies;
@@ -132,6 +138,25 @@ def _is_jax_jit(func) -> bool:
     return d == "jax.jit" or d == "jit" or d.endswith(".jit")
 
 
+def _partial_jit_aliases(sf: SourceFile) -> Dict[str, ast.Call]:
+    """Module-level `jit = functools.partial(jax.jit, ...)` aliases:
+    name -> the partial() Call carrying the jit kwargs. Call sites of
+    the alias are jit sites with those kwargs (a previously-missed
+    form — the bench's flagship program is built this way)."""
+    aliases: Dict[str, ast.Call] = {}
+    for node in sf.tree.body:
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        c = node.value
+        if call_name(c) == "partial" and c.args \
+                and _is_jax_jit(c.args[0]):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    aliases[t.id] = c
+    return aliases
+
+
 def collect_jit_sites(sources: List[SourceFile]) -> List[JitSite]:
     sites: List[JitSite] = []
     for sf in sources:
@@ -139,23 +164,47 @@ def collect_jit_sites(sources: List[SourceFile]) -> List[JitSite]:
         for node in ast.walk(sf.tree):
             for child in ast.iter_child_nodes(node):
                 parents[id(child)] = node
+        aliases = _partial_jit_aliases(sf)
         for node in ast.walk(sf.tree):
             # call form: jax.jit(X, ...) — possibly partial(jax.jit, ...)
+            # (plain or functools-qualified), or a module-level
+            # partial-alias call site `step = jit(step_fn)`
             if isinstance(node, ast.Call):
                 jit_call = None
+                alias_call = None
                 wrapped = ""
                 if _is_jax_jit(node.func):
                     jit_call = node
                     wrapped = _wrapped_name(node.args[0]) \
                         if node.args else ""
-                elif (isinstance(node.func, ast.Name)
-                      and node.func.id == "partial" and node.args
+                elif (call_name(node) == "partial" and node.args
                       and _is_jax_jit(node.args[0])):
                     jit_call = node
                     wrapped = ""          # decorator form fills it in
+                elif (isinstance(node.func, ast.Name)
+                      and node.func.id in aliases):
+                    jit_call = node
+                    alias_call = aliases[node.func.id]
+                    wrapped = _wrapped_name(node.args[0]) \
+                        if node.args else ""
+                elif (isinstance(node.func, ast.Call)
+                      and call_name(node.func) == "partial"
+                      and node.func.args
+                      and _is_jax_jit(node.func.args[0])):
+                    # chained form: functools.partial(jax.jit, ...)(f)
+                    jit_call = node
+                    alias_call = node.func
+                    wrapped = _wrapped_name(node.args[0]) \
+                        if node.args else ""
                 if jit_call is None:
                     continue
                 donate, static, nums = _jit_kwargs(jit_call)
+                if alias_call is not None:
+                    # kwargs split between the partial and the call site
+                    a_donate, a_static, a_nums = _jit_kwargs(alias_call)
+                    donate = donate or a_donate
+                    static = static or a_static
+                    nums = nums if nums is not None else a_nums
                 # decorator? the parent chain reaches a FunctionDef
                 # whose decorator_list contains us
                 parent = parents.get(id(node))
@@ -175,10 +224,18 @@ def collect_jit_sites(sources: List[SourceFile]) -> List[JitSite]:
                     continue
                 sites.append(JitSite(sf, node.lineno, wrapped, bound_to,
                                      donate, static, nums))
-            # bare @jax.jit decorator (an Attribute, not a Call)
+            # bare @jax.jit decorator (an Attribute, not a Call) — or a
+            # bare @<alias> decorator carrying the partial's kwargs
             elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 for dec in node.decorator_list:
-                    if not isinstance(dec, ast.Call) and _is_jax_jit(dec):
+                    if isinstance(dec, ast.Name) and dec.id in aliases:
+                        donate, static, nums = _jit_kwargs(
+                            aliases[dec.id])
+                        sites.append(JitSite(sf, node.lineno, node.name,
+                                             node.name, donate, static,
+                                             nums))
+                    elif not isinstance(dec, ast.Call) \
+                            and _is_jax_jit(dec):
                         sites.append(JitSite(sf, node.lineno, node.name,
                                              node.name, False, False))
     return sites

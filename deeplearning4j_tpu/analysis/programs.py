@@ -1,0 +1,203 @@
+"""The representative compiled-program set `dl4j-analyze --programs`
+lints.
+
+One small instance of every registered compiled-program family, built
+the same way production builds them (same cache paths, same policy
+registration) but at CPU-lintable dims:
+
+  engine_single / _group_k4   StepProgram on a bf16 mixed-precision MLP
+  engine_graph                StepProgram on a ComputationGraph (the
+                              flat-chain train program)
+  engine_tbptt                the train_c program with donated carries
+  serving_predict / buckets   ParallelInference warmup + a short driven
+                              load, so bucket fill is MEASURED
+  clustering_kmeans_lloyd     the donated Lloyd iteration
+  clustering_tsne_step        the donated embedding step (the program
+                              whose dropped donation the first audit
+                              run caught — PERF.md)
+  bench_flagship_k_steps      the bench's ResNet50 k-step program at
+                              reduced dims, lower-only (XLA-compiling
+                              it takes minutes on CPU; the dtype and
+                              alias-map rules only need the lowering)
+  graft_entry_forward         the published __graft_entry__ forward,
+                              pinned to the flagship bf16 policy (the
+                              fp32-default the first audit run caught)
+
+Everything here imports jax — it is loaded lazily by the runner ONLY
+in `--programs` mode, so the default AST-only CLI keeps its zero-
+dependency contract. The CLI pins JAX_PLATFORMS=cpu before anything
+imports jax; the whole set builds + lints in well under 60s on CPU.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from pathlib import Path
+from typing import List
+
+from deeplearning4j_tpu.analysis.program_lint import ProgramRecord
+
+_ROOT = Path(__file__).resolve().parents[2]
+
+
+def _engine_records() -> List[ProgramRecord]:
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu import (
+        MultiLayerNetwork,
+        NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.engine import StepProgram
+    from deeplearning4j_tpu.nn.conf import InputType
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.layers import (
+        LSTM,
+        DenseLayer,
+        OutputLayer,
+        RnnOutputLayer,
+    )
+
+    records: List[ProgramRecord] = []
+
+    # single step + k-group on the bf16 mixed-precision MLP
+    conf = (NeuralNetConfiguration.Builder().seed(7).updater("adam")
+            .learning_rate(1e-3).activation("relu")
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=32))
+            .layer(OutputLayer(n_out=8, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(16)).build())
+    net = MultiLayerNetwork(conf, compute_dtype="bfloat16").init()
+    records += StepProgram(net).lint_records(
+        jnp.zeros((8, 16), jnp.float32), jnp.zeros((8, 8), jnp.float32),
+        k=4)
+
+    # ComputationGraph variant (flat-chain train program)
+    gconf = (NeuralNetConfiguration.Builder().seed(5).updater("adam")
+             .learning_rate(1e-3).activation("relu")
+             .weight_init("xavier").graph_builder()
+             .add_inputs("in")
+             .add_layer("d1", DenseLayer(n_out=16), "in")
+             .add_layer("out", OutputLayer(n_out=4, loss="mcxent"),
+                        "d1")
+             .set_outputs("out")
+             .set_input_types(**{"in": InputType.feed_forward(8)})
+             .build())
+    g = ComputationGraph(gconf, compute_dtype="bfloat16").init()
+    records += StepProgram(g).lint_records(
+        jnp.zeros((8, 8), jnp.float32), jnp.zeros((8, 4), jnp.float32))
+
+    # truncated-BPTT LSTM (the train_c program with donated carries)
+    rconf = (NeuralNetConfiguration.Builder().seed(3).updater("adam")
+             .learning_rate(1e-3).weight_init("xavier").list()
+             .layer(LSTM(n_out=16))
+             .layer(RnnOutputLayer(n_out=4, loss="mcxent"))
+             .set_input_type(InputType.recurrent(8))
+             .backprop_type("truncated_bptt")
+             .t_bptt_forward_length(4).t_bptt_backward_length(4)
+             .build())
+    rnet = MultiLayerNetwork(rconf, compute_dtype="bfloat16").init()
+    records += StepProgram(rnet).lint_records(
+        jnp.zeros((2, 4, 8), jnp.float32),
+        jnp.zeros((2, 4, 4), jnp.float32))
+    return records
+
+
+def _serving_records() -> List[ProgramRecord]:
+    import numpy as np
+
+    from deeplearning4j_tpu import (
+        MultiLayerNetwork,
+        NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.nn.conf import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+    conf = (NeuralNetConfiguration.Builder().seed(11).updater("sgd")
+            .learning_rate(0.05).activation("tanh")
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=32))
+            .layer(OutputLayer(n_out=8, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(16)).build())
+    net = MultiLayerNetwork(conf, compute_dtype="bfloat16").init()
+    pi = ParallelInference(net, batch_limit=8, queue_limit=16,
+                           max_wait_ms=1.0, warmup=True,
+                           pipeline_depth=0)
+    try:
+        # drive a short load so bucket fill is measured, not assumed
+        for rows in (8, 8, 4):
+            pi.output(np.zeros((rows, 16), np.float32), timeout_s=60.0)
+        return pi.lint_records()
+    finally:
+        pi.shutdown()
+
+
+def _clustering_records() -> List[ProgramRecord]:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.clustering import kmeans, tsne
+
+    rng = np.random.default_rng(0)
+    pts = jnp.asarray(rng.normal(size=(32, 4)).astype(np.float32))
+    records = [ProgramRecord(
+        name="clustering_kmeans_lloyd", fn=kmeans._lloyd_step,
+        example_args=(pts, pts[:4]),
+        example_kwargs={"metric": "euclidean"},
+        precision_policy="f32",
+        source="deeplearning4j_tpu/clustering/kmeans.py")]
+
+    n, k, blk, c = 6, 3, 4, 2
+    n_pad = -(-n // blk) * blk      # 8: pad-mismatch donation case
+    y = jnp.zeros((n_pad, c), jnp.float32)
+    records.append(ProgramRecord(
+        name="clustering_tsne_step", fn=tsne._chunked_step,
+        example_args=(y, jnp.zeros_like(y),
+                      jnp.zeros((n, k), jnp.int32),
+                      jnp.full((n, k), 1e-3, jnp.float32),
+                      jnp.zeros((n, k), bool),
+                      jnp.float32(4.0), jnp.float32(0.5),
+                      jnp.float32(100.0)),
+        example_kwargs={"row_block": blk, "n_real": n},
+        precision_policy="f32",
+        source="deeplearning4j_tpu/clustering/tsne.py"))
+    return records
+
+
+def _flagship_records() -> List[ProgramRecord]:
+    if str(_ROOT) not in sys.path:
+        sys.path.insert(0, str(_ROOT))
+    spec = importlib.util.spec_from_file_location(
+        "dl4j_bench", _ROOT / "bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    jit_k, args, _, _ = bench.make_flagship_program(
+        batch=2, hw=32, n_classes=8, unroll=2)
+    records = [ProgramRecord(
+        name="bench_flagship_k_steps", fn=jit_k, example_args=args,
+        precision_policy="bf16", compile=False, source="bench.py",
+        consumed_outputs=(0, 1, 2, 3))]
+
+    from __graft_entry__ import entry
+
+    fwd, fargs = entry(hw=32, n_classes=8)
+    records.append(ProgramRecord(
+        name="graft_entry_forward", fn=fwd, example_args=fargs,
+        precision_policy="bf16", compile=False,
+        source="__graft_entry__.py"))
+    return records
+
+
+def build_default_records() -> List[ProgramRecord]:
+    """Build the whole representative set. Pins JAX_PLATFORMS=cpu when
+    nothing chose a platform yet — the lint must behave identically on
+    a TPU host and in CI."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    records: List[ProgramRecord] = []
+    records += _engine_records()
+    records += _serving_records()
+    records += _clustering_records()
+    records += _flagship_records()
+    return records

@@ -1,9 +1,14 @@
-"""Orchestrates the three static passes + baseline + CLI.
+"""Orchestrates the static passes + the program pass + baseline + CLI.
 
 Used two ways:
 
   - `tools/analyze.py` (zero-dependency CLI; exit 0 = clean vs
-    baseline, 1 = new findings, 2 = usage error)
+    baseline, 1 = new findings, 2 = usage error). The default run is
+    the three AST passes — parsed, never imported, no jax. The
+    `--programs` mode adds pass 4 (analysis/program_lint): it imports
+    jax (pinned to JAX_PLATFORMS=cpu), builds the representative
+    program set (analysis/programs), and lints jaxpr/lowered/compiled
+    HLO against each program's declared facts.
   - `tests/test_static_analysis.py` runs `analyze()` inside tier-1 so
     a new violation fails CI with the same report a developer sees
     locally.
@@ -31,6 +36,7 @@ from deeplearning4j_tpu.analysis.findings import (
 from deeplearning4j_tpu.analysis.source import load_sources
 
 PASSES = ("jit", "concurrency", "conformance")
+PROGRAM_PASS = "programs"
 
 
 @dataclass
@@ -40,6 +46,7 @@ class AnalysisResult:
     suppressed: List[Finding] = field(default_factory=list)
     stale: List[dict] = field(default_factory=list)
     files_scanned: int = 0
+    programs_checked: int = 0
     catalog: Optional[object] = None
 
     @property
@@ -50,21 +57,26 @@ class AnalysisResult:
 def analyze(pkg_dir, root=None, tests_dir=None,
             baseline: Optional[Baseline] = None,
             passes: Sequence[str] = PASSES,
-            only: Optional[Set[str]] = None) -> AnalysisResult:
+            only: Optional[Set[str]] = None,
+            program_records=None) -> AnalysisResult:
     """Run the selected passes over `pkg_dir`.
 
     `only` (repo-relative paths) limits which files *report* findings
     (--diff mode); the conformance pass still reads the whole package —
     registry equality is a global property — but its findings are
-    filtered to the changed files."""
+    filtered to the changed files. The "programs" pass lints
+    `program_records` (default: the representative set from
+    analysis/programs — imports jax)."""
     pkg_dir = Path(pkg_dir)
     root = Path(root) if root is not None else pkg_dir.parent
-    sources = load_sources(pkg_dir, root)
+    ast_passes = [p for p in passes if p != PROGRAM_PASS]
+    sources = load_sources(pkg_dir, root) if ast_passes else []
     narrowed = sources if only is None \
         else [sf for sf in sources if sf.rel in only]
 
     findings: List[Finding] = []
     catalog = None
+    programs_checked = 0
     if "jit" in passes:
         all_jit = jit_lint.run(sources)
         findings += [f for f in all_jit
@@ -76,10 +88,21 @@ def analyze(pkg_dir, root=None, tests_dir=None,
         conf = conformance.run(sources, tests_dir=tests_dir)
         findings += [f for f in conf
                      if only is None or f.file in only]
+    if PROGRAM_PASS in passes:
+        from deeplearning4j_tpu.analysis import program_lint
+        records = program_records
+        if records is None:
+            from deeplearning4j_tpu.analysis import programs
+            records = programs.build_default_records()
+        programs_checked = len(records)
+        prog = program_lint.run(records)
+        findings += [f for f in prog
+                     if only is None or f.file in only]
 
     findings.sort(key=lambda f: (f.file, f.line, f.rule))
     res = AnalysisResult(findings=findings,
                          files_scanned=len(narrowed),
+                         programs_checked=programs_checked,
                          catalog=catalog)
     if baseline is None:
         res.new = list(findings)
@@ -149,16 +172,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--passes", default=",".join(PASSES),
                     help=f"comma list of passes (default: all of "
                          f"{','.join(PASSES)})")
+    ap.add_argument("--programs", action="store_true",
+                    help="run pass 4 (compiled-program lint) over the "
+                         "representative program set instead of the "
+                         "AST passes — imports jax, pinned to "
+                         "JAX_PLATFORMS=cpu")
     args = ap.parse_args(argv)
 
     if args.rules:
         for r in RULES.values():
             print(f"{r.id:28s} [{r.pass_name}] {r.description}")
-        print(f"{len(RULES)} rules "
-              f"({sum(1 for r in RULES.values() if r.pass_name != 'runtime')}"
-              f" static, "
-              f"{sum(1 for r in RULES.values() if r.pass_name == 'runtime')}"
-              f" runtime sanitizer)")
+        by_kind = {"static": 0, "program": 0, "runtime": 0}
+        for r in RULES.values():
+            kind = r.pass_name if r.pass_name in by_kind else "static"
+            by_kind[kind] += 1
+        print(f"{len(RULES)} rules ({by_kind['static']} static, "
+              f"{by_kind['program']} program, "
+              f"{by_kind['runtime']} runtime sanitizer)")
         return 0
 
     root = Path(args.root) if args.root else \
@@ -191,12 +221,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                   f"{args.diff}; nothing to check")
             return 0
 
-    passes = tuple(p.strip() for p in args.passes.split(",")
-                   if p.strip())
-    for p in passes:
-        if p not in PASSES:
-            print(f"error: unknown pass '{p}'", file=sys.stderr)
-            return 2
+    if args.programs:
+        import os
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        passes = (PROGRAM_PASS,)
+    else:
+        passes = tuple(p.strip() for p in args.passes.split(",")
+                       if p.strip())
+        for p in passes:
+            if p not in PASSES:
+                print(f"error: unknown pass '{p}'", file=sys.stderr)
+                return 2
 
     baseline = None
     if not args.no_baseline and not args.write_baseline \
@@ -223,9 +258,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     by_rule = {}
     for f in res.findings:
         by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    scanned = (f"{res.programs_checked} programs"
+               if PROGRAM_PASS in passes
+               else f"{res.files_scanned} files")
     print(f"dl4j-analyze: {len(res.new)} new finding(s), "
           f"{len(res.suppressed)} baselined, {len(res.stale)} stale "
-          f"baseline entr(ies); {res.files_scanned} files, "
+          f"baseline entr(ies); {scanned}, "
           f"{len(RULES)} rules"
           + (f"; by rule: " +
              ", ".join(f"{k}={v}" for k, v in sorted(by_rule.items()))

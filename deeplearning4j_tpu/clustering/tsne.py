@@ -118,15 +118,24 @@ def _symmetrize_block(idx_blk, p_blk, row0, idx_all, p_all,
 
 
 @partial(jax.jit, static_argnames=("row_block", "n_real"),
-         donate_argnums=(0,))
-def _chunked_step(y, idx, psym, mutual, exaggeration, row_block: int,
-                  n_real: int):
-    """One gradient iteration with the repulsive term streamed over
-    [row_block, N] blocks: returns (grad [n_real,C], kl). One scan
-    accumulates BOTH the partition constant Z and the unscaled
-    repulsive blocks (1/Z is a scalar, applied after). `y` is padded
-    to a multiple of row_block with far-away sentinel rows (their
-    student-t kernel ~ 0; masked anyway)."""
+         donate_argnums=(0, 1))
+def _chunked_step(y, vel, idx, psym, mutual, exaggeration, momentum,
+                  lr, row_block: int, n_real: int):
+    """One full embedding iteration with the repulsive term streamed
+    over [row_block, N] blocks: returns (y_new [n_pad,C], vel_new,
+    kl). One scan accumulates BOTH the partition constant Z and the
+    unscaled repulsive blocks (1/Z is a scalar, applied after). `y` is
+    padded to a multiple of row_block with far-away sentinel rows
+    (their student-t kernel ~ 0; masked anyway; they stay put).
+
+    The momentum update + recentering live INSIDE the program so the
+    donated y/vel buffers alias the outputs. The previous shape — grad
+    [n_real,C] returned to a host-side update — declared the donation
+    but could never honor it (a padded [n_pad,C] input cannot alias an
+    [n_real,C] output), which the program lint's
+    prog-unhonored-donation rule caught on its first run (PERF.md);
+    owning the update also fuses three host-side elementwise dispatches
+    into the step."""
     n_pad, C = y.shape
     nb = n_pad // row_block
 
@@ -177,7 +186,17 @@ def _chunked_step(y, idx, psym, mutual, exaggeration, row_block: int,
     # ordered-pair sum counts every pair twice
     kl = jnp.sum(kl_terms) + jnp.sum(
         jnp.where(mutual, 0.0, kl_terms))
-    return grad, kl
+
+    # momentum update + per-iteration recentering on the REAL rows;
+    # sentinel rows keep their far-away positions and zero velocity
+    grad_pad = jnp.pad(grad, ((0, n_pad - n_real), (0, 0)))
+    vel_new = momentum * vel - lr * grad_pad
+    y_new = y + vel_new
+    mean = jnp.mean(y_new[:n_real], axis=0)
+    real = (jnp.arange(n_pad) < n_real)[:, None]
+    y_out = jnp.where(real, y_new - mean, y)
+    vel_out = jnp.where(real, vel_new, 0.0)
+    return y_out, vel_out, kl
 
 
 class Tsne:
@@ -283,22 +302,23 @@ class Tsne:
         n_pad = -(-n // blk) * blk
         key = jax.random.PRNGKey(self.seed)
         y = 1e-4 * jax.random.normal(key, (n, self.n_components))
-        vel = jnp.zeros_like(y)
-        # sentinel rows sit far away: their kernel vs everything ~ 0
+        # sentinel rows sit far away: their kernel vs everything ~ 0;
+        # y/vel stay padded across the whole loop (ONE concatenate,
+        # donated through every iteration)
         pad_rows = jnp.full((n_pad - n, self.n_components), 1e6)
+        y_pad = jnp.concatenate([y, pad_rows], axis=0)
+        vel = jnp.zeros_like(y_pad)
         kl = None
         for it in range(self.max_iter):
             ex = (self.early_exaggeration
                   if it < self.stop_lying_iteration else 1.0)
             mom = (self.initial_momentum
                    if it < self.momentum_switch else self.final_momentum)
-            y_pad = jnp.concatenate([y, pad_rows], axis=0)
-            grad, kl = _chunked_step(y_pad, idx_j, psym, mutual, ex,
-                                     blk, n)
-            vel = mom * vel - self.learning_rate * grad
-            y = y + vel
-            y = y - jnp.mean(y, axis=0)
+            y_pad, vel, kl = _chunked_step(
+                y_pad, vel, idx_j, psym, mutual, jnp.float32(ex),
+                jnp.float32(mom), jnp.float32(self.learning_rate),
+                blk, n)
         self.kl_ = float(kl)
-        return np.asarray(y)
+        return np.asarray(y_pad[:n])
 
     fit = fit_transform

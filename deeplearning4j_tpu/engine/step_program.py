@@ -112,6 +112,14 @@ class StepProgram:
         self.is_graph = hasattr(net.conf, "network_inputs")
         self.is_tbptt = getattr(net.conf, "backprop_type", None) \
             == "truncated_bptt"
+        # the DECLARED compute-precision policy of every program this
+        # StepProgram compiles ('bf16'/'f16' mixed precision, 'f32'
+        # default) — an explicit registration fact the program lint
+        # checks the lowered programs against, never a guess
+        from deeplearning4j_tpu.nn.jit_cache import policy_name
+
+        self.precision_policy = policy_name(
+            getattr(net, "compute_dtype", None))
         # [k] dp-visible per-inner-step losses of the newest run_group
         # dispatch (device array; fetched by the guard only on checked
         # groups so the hot loop never syncs)
@@ -243,6 +251,7 @@ class StepProgram:
         if key not in cache:
             cache[key] = self._build_group(
                 k, fms is not None, lms is not None, str(key))
+            cache.register_policy(key, self.precision_policy)
         (net.params, net.updater_states, net.states, net._rng,
          losses) = cache[key](
             net.params, net.updater_states, net.states, net._rng,
@@ -252,6 +261,66 @@ class StepProgram:
         self.last_step_losses = losses
         net._score = losses[-1]
         return losses[-1]
+
+    # ------------------------------------------------------------- lint
+    def lint_records(self, x, y, fm=None, lm=None, k=None, name=None):
+        """ProgramRecords for this net's compiled step programs — the
+        k=1 single step (graph/TBPTT adaptation included) and, when
+        `k` is given, the k-step scan group — for
+        `analysis/program_lint`. Programs are built and
+        policy-registered through the same cache paths `run`/`run_group`
+        use, but only traced/lowered by the lint, never executed, so
+        the net's live (donated) buffers stay valid."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.analysis.program_lint import (
+            ProgramRecord,
+        )
+
+        net = self.net
+        base = name or ("engine_graph" if self.is_graph
+                        else "engine_single")
+        carries = None
+        if self.is_tbptt:
+            batch = int(np.asarray(x).shape[0])
+            carries = net._initial_carries(batch)
+            base = name or "engine_tbptt"
+        if self.is_graph:
+            ins, labs, fms, lms = self._graph_args(x, y, fm, lm)
+            fn, args = net.lint_program(ins, labs, fms, lms,
+                                        carries=carries)
+        else:
+            fn, args = net.lint_program(x, y, fm, lm, carries=carries)
+        source = "deeplearning4j_tpu/engine/step_program.py"
+        # every output of the step contract is consumed by the fit
+        # loops (params/upd/states/carries, loss) — declaring that
+        # arms prog-dead-output against a future output nobody binds
+        records = [ProgramRecord(
+            name=base, fn=fn, example_args=args,
+            precision_policy=self.precision_policy, source=source,
+            consumed_outputs=tuple(range(5)))]
+        if k:
+            xs = jnp.broadcast_to(jnp.asarray(x), (k,) + np.shape(x))
+            ys = jnp.broadcast_to(jnp.asarray(y), (k,) + np.shape(y))
+            if self.is_graph:
+                xs, ys, _, _ = self._graph_args(xs, ys, None, None)
+            key = self.group_key(k, False, False)
+            cache = net._jit_cache
+            if key not in cache:
+                cache[key] = self._build_group(k, False, False, str(key))
+                cache.register_policy(key, self.precision_policy)
+            gfn = cache[key]
+            gargs = (net.params, net.updater_states, net.states,
+                     net._rng, jnp.asarray(net.iteration, jnp.int32),
+                     xs, ys, None, None,
+                     jnp.asarray(net._lr_score_factor, jnp.float32))
+            records.append(ProgramRecord(
+                name=f"{base}_group_k{k}",
+                fn=getattr(gfn, "__wrapped__", gfn),
+                example_args=gargs,
+                precision_policy=self.precision_policy, source=source,
+                consumed_outputs=tuple(range(5))))
+        return records
 
     # ------------------------------------------------------------- perf
     def register_perf(self, cost_model, key=None, *example_args,

@@ -24,7 +24,7 @@ from deeplearning4j_tpu.nn.conf.graph_conf import (
     GraphNode,
 )
 from deeplearning4j_tpu.nn.conf.graph_vertices import LastTimeStepVertex
-from deeplearning4j_tpu.nn.jit_cache import JitCache
+from deeplearning4j_tpu.nn.jit_cache import JitCache, policy_name
 from deeplearning4j_tpu.nn.layers.core import BaseOutputLayer
 from deeplearning4j_tpu.nn.layers.recurrent import (
     LSTM,
@@ -368,7 +368,11 @@ class ComputationGraph:
             new_upd = dict(zip(layer_names, nu_list))
             return new_params, new_upd, new_states, new_carries, loss
 
-        return jax.jit(step_fn, donate_argnums=(0, 1, 2))
+        # with_carries also donates the RNN carries (arg 9): the TBPTT
+        # loop rebinds them every chunk, so new_carries aliases the old
+        # buffers (verified by the program lint's alias-map check)
+        return jax.jit(step_fn, donate_argnums=(
+            (0, 1, 2, 9) if with_carries else (0, 1, 2)))
 
     def _build_flat_train_step(self, with_carries: bool, chain):
         """Grad-over-flat variant of the train step: differentiates
@@ -408,7 +412,8 @@ class ComputationGraph:
             deltas, new_u = chain.updater.update(g, uflat, flat, lr, step)
             return flat + deltas, new_u, new_states, new_carries, loss
 
-        return jax.jit(step_fn, donate_argnums=(0, 1, 2))
+        return jax.jit(step_fn, donate_argnums=(
+            (0, 1, 2, 9) if with_carries else (0, 1, 2)))
 
     def _train_step(self, inputs, labels, fmasks=None, lmasks=None,
                     carries=None):
@@ -422,6 +427,8 @@ class ComputationGraph:
             if key not in self._jit_cache:
                 self._jit_cache[key] = self._build_flat_train_step(
                     carries is not None, chain)
+                self._jit_cache.register_policy(
+                    key, policy_name(self.compute_dtype))
             if self._flat_train is None:
                 self._flat_train = (chain.ravel(self._params),
                                     chain.ravel_upd(self._upd_states))
@@ -443,6 +450,8 @@ class ComputationGraph:
             if key not in self._jit_cache:
                 self._jit_cache[key] = self._build_train_step(
                     carries is not None)
+                self._jit_cache.register_policy(
+                    key, policy_name(self.compute_dtype))
             (self.params, self.updater_states, self.states, new_carries,
              loss) = self._jit_cache[key](
                 self.params, self.updater_states, self.states,
@@ -457,6 +466,45 @@ class ComputationGraph:
     def _apply_score_decay(self, loss):
         from deeplearning4j_tpu.nn.updater import apply_score_decay
         apply_score_decay(self, loss)
+
+    def lint_program(self, inputs, labels, fmasks=None, lmasks=None,
+                     carries=None):
+        """(jitted_fn, example_args) of the cached donated train step
+        on the SAME path `_train_step` would take (flat-chain when
+        eligible) — the program-lint view; traced/lowered, never
+        executed."""
+        with_carries = carries is not None
+        frozen_sig = tuple(sorted(n.name for n in self.topo
+                                  if n.kind == "layer" and n.obj.frozen))
+        chain = self._flat_chain_obj() if not frozen_sig else None
+        _, sub = jax.random.split(self._rng)
+        tail = (jnp.asarray(self.iteration, jnp.int32), inputs, labels,
+                fmasks, lmasks, sub, carries,
+                jnp.asarray(self._lr_score_factor, jnp.float32))
+        if chain is not None:
+            key = ("train_flat_c" if with_carries else "train_flat",)
+            if key not in self._jit_cache:
+                self._jit_cache[key] = self._build_flat_train_step(
+                    with_carries, chain)
+                self._jit_cache.register_policy(
+                    key, policy_name(self.compute_dtype))
+            if self._flat_train is not None:
+                flat, uflat = self._flat_train
+            else:
+                flat = chain.ravel(self.params)
+                uflat = chain.ravel_upd(self.updater_states)
+            args = (flat, uflat, self.states) + tail
+        else:
+            key = ("train_c" if with_carries else "train", frozen_sig)
+            if key not in self._jit_cache:
+                self._jit_cache[key] = self._build_train_step(
+                    with_carries)
+                self._jit_cache.register_policy(
+                    key, policy_name(self.compute_dtype))
+            args = (self.params, self.updater_states,
+                    self.states) + tail
+        fn = self._jit_cache[key]
+        return getattr(fn, "__wrapped__", fn), args
 
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, epochs: int = 1):
@@ -589,6 +637,8 @@ class ComputationGraph:
                 return [acts[n].astype(self.dtype) if cd is not None
                         else acts[n] for n in self.conf.network_outputs]
             self._jit_cache["predict"] = jax.jit(predict_fn)
+            self._jit_cache.register_policy(
+                "predict", policy_name(self.compute_dtype))
         outs = self._jit_cache["predict"](self.params, self.states, inputs)
         return outs[0] if len(outs) == 1 else outs
 

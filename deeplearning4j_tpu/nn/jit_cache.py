@@ -42,6 +42,24 @@ from deeplearning4j_tpu.observability import metrics as _obs
 COMPILE_RING = 16
 
 
+def policy_name(compute_dtype) -> str:
+    """Canonical short name of a net's compute-precision policy:
+    'bf16'/'f16' for mixed precision, 'f32' when no compute dtype is
+    set. This is the DECLARED intent the program lint checks lowered
+    programs against (prog-fp32-matmul-under-policy) — a declared fact
+    at registration time, never a guess from the jaxpr."""
+    if compute_dtype is None:
+        return "f32"
+    import numpy as np
+
+    try:
+        name = np.dtype(compute_dtype).name
+    except TypeError:
+        name = getattr(compute_dtype, "__name__", str(compute_dtype))
+    return {"bfloat16": "bf16", "float16": "f16", "float32": "f32",
+            "float64": "f64"}.get(name, name)
+
+
 def _describe(a, depth: int = 0) -> str:
     """Compact signature of one argument: arrays as dtype[shape],
     containers abbreviated to their first few entries."""
@@ -93,6 +111,7 @@ class JitCache(dict):
         self._compile_events: deque = deque(
             maxlen=max(1, int(compile_ring)))
         self._costs: Dict[str, dict] = {}
+        self._policies: Dict[str, str] = {}
         for k, v in dict(*args, **kwargs).items():
             self[k] = v
 
@@ -176,6 +195,22 @@ class JitCache(dict):
     def costs(self) -> Dict[str, dict]:
         with self._trace_lock:
             return {k: dict(v) for k, v in self._costs.items()}
+
+    def register_policy(self, key, policy: str) -> None:
+        """Declare the compute-precision policy of the program stored
+        at `key` ('bf16'/'f16'/'f32' — see `policy_name`). The program
+        lint reads this back so 'intended dtype' is a registered fact
+        the lowered program is checked against."""
+        with self._trace_lock:
+            self._policies[str(key)] = str(policy)
+
+    def policy(self, key) -> Optional[str]:
+        with self._trace_lock:
+            return self._policies.get(str(key))
+
+    def policies(self) -> Dict[str, str]:
+        with self._trace_lock:
+            return dict(self._policies)
 
     def compile_events(self) -> List[dict]:
         """Snapshot of the recent-compiles ring, oldest first."""

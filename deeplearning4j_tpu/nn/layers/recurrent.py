@@ -119,9 +119,19 @@ class LSTM(BaseLayer):
         cell_act = get_activation(self.activation)
         peep = tuple(params["P"]) if self._peepholes else None
 
-        # Hoist the input projection for all timesteps: one big MXU matmul.
-        xw = x @ params["W"] + params["b"]          # [B, T, 4H]
-        xw_t = jnp.swapaxes(xw, 0, 1)               # [T, B, 4H] time-major scan
+        # Hoist the input projection for all timesteps: one big MXU
+        # matmul. Project AFTER going time-major when the input is the
+        # smaller tensor (nIn <= 4H — every stacked layer, and any
+        # vocab < 4H): the layout swap then moves [B,T,nIn] bytes
+        # instead of the up-to-4x bigger [B,T,4H] projection. Same
+        # contraction, bit-identical outputs — the program lint's
+        # transpose-churn byte accounting flagged the old order
+        # (PERF.md item-1 baseline audit).
+        if x.shape[-1] <= 4 * self.n_out:
+            xw_t = (jnp.swapaxes(x, 0, 1) @ params["W"]
+                    + params["b"])                  # [T, B, 4H]
+        else:
+            xw_t = jnp.swapaxes(x @ params["W"] + params["b"], 0, 1)
         mask_t = None if mask is None else jnp.swapaxes(mask, 0, 1)  # [T, B]
 
         def body(carry, inputs):

@@ -32,7 +32,7 @@ from deeplearning4j_tpu.nn.conf.network import (
     BackpropType,
     MultiLayerConfiguration,
 )
-from deeplearning4j_tpu.nn.jit_cache import JitCache
+from deeplearning4j_tpu.nn.jit_cache import JitCache, policy_name
 from deeplearning4j_tpu.nn.layers.base import Layer
 from deeplearning4j_tpu.nn.layers.core import BaseOutputLayer
 from deeplearning4j_tpu.nn.layers.recurrent import LSTM, GravesBidirectionalLSTM
@@ -297,7 +297,12 @@ class MultiLayerNetwork:
                  for i in range(len(params))], lr, step)
             return new_params, new_upd, new_states, new_carries, loss
 
-        return jax.jit(step_fn, donate_argnums=(0, 1, 2))
+        # the with_carries program also donates the RNN carries (arg 9):
+        # the caller (_fit_tbptt) rebinds them every chunk, so
+        # new_carries aliases the old [B,H] buffers instead of copying
+        # them — verified honored by the program lint's alias-map check
+        return jax.jit(step_fn, donate_argnums=(
+            (0, 1, 2, 9) if with_carries else (0, 1, 2)))
 
     def _train_step(self, x, y, fmask=None, lmask=None, carries=None):
         # frozen flags are baked into the traced step; key the cache on
@@ -307,6 +312,8 @@ class MultiLayerNetwork:
         key = ("train_c" if carries is not None else "train", frozen_sig)
         if key not in self._jit_cache:
             self._jit_cache[key] = self._build_train_step(carries is not None)
+            self._jit_cache.register_policy(
+                key, policy_name(self.compute_dtype))
         self._rng, sub = jax.random.split(self._rng)
         (self.params, self.updater_states, self.states, new_carries,
          loss) = self._jit_cache[key](
@@ -321,6 +328,27 @@ class MultiLayerNetwork:
     def _apply_score_decay(self, loss):
         from deeplearning4j_tpu.nn.updater import apply_score_decay
         apply_score_decay(self, loss)
+
+    def lint_program(self, x, y, fm=None, lm=None, carries=None):
+        """(jitted_fn, example_args) of the cached donated train step
+        exactly as `_train_step` invokes it — the program-lint view
+        (analysis/program_lint traces and lowers it, never executes,
+        so the donated live buffers stay valid)."""
+        with_carries = carries is not None
+        frozen_sig = tuple(i for i, l in enumerate(self.conf.layers)
+                           if l.frozen)
+        key = ("train_c" if with_carries else "train", frozen_sig)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self._build_train_step(with_carries)
+            self._jit_cache.register_policy(
+                key, policy_name(self.compute_dtype))
+        _, sub = jax.random.split(self._rng)
+        args = (self.params, self.updater_states, self.states,
+                jnp.asarray(self.iteration, jnp.int32), x, y, fm, lm,
+                sub, carries,
+                jnp.asarray(self._lr_score_factor, jnp.float32))
+        fn = self._jit_cache[key]
+        return getattr(fn, "__wrapped__", fn), args
 
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, epochs: int = 1):
@@ -456,6 +484,8 @@ class MultiLayerNetwork:
                                           train=False, rng=None)
                 return out.astype(self.dtype) if cd is not None else out
             self._jit_cache["predict"] = jax.jit(predict_fn)
+            self._jit_cache.register_policy(
+                "predict", policy_name(self.compute_dtype))
         return self._jit_cache["predict"](self.params, self.states, x)
 
     def feed_forward(self, x, train: bool = False):

@@ -264,6 +264,9 @@ class ParallelInference:
         self._warmed_buckets: List[int] = []
         self._batches_dispatched = 0
         self._requests_completed = 0
+        # bucket -> [dispatches, real rows]: the pow2 fill accounting
+        # the program lint's prog-excess-padding rule reads
+        self._bucket_fill: Dict[int, List[int]] = {}
         if self.mode == InferenceMode.BATCHED:
             if warmup:
                 self.warmup()
@@ -316,6 +319,54 @@ class ParallelInference:
             out["compile_events"] = cache.compile_events()
         return out
 
+    def bucket_fill(self) -> Dict[int, dict]:
+        """Per-bucket padding accounting: {bucket: {dispatches, rows,
+        fill}} where fill = real rows / (dispatches * bucket). The pow2
+        coalescer guarantees fill > 0.5 per dispatch; the program
+        lint's prog-excess-padding rule pins that invariant."""
+        return {b: {"dispatches": d, "rows": r,
+                    "fill": (r / (d * b)) if d else 0.0}
+                for b, (d, r) in sorted(self._bucket_fill.items())}
+
+    def lint_records(self) -> list:
+        """ProgramRecords for the serving data plane: the net's cached
+        predict program at the largest warmed bucket signature (with
+        its registered precision policy) plus one fill-ratio record per
+        dispatched bucket — the `--programs` registry entries for this
+        front-end (analysis/program_lint)."""
+        from deeplearning4j_tpu.analysis.program_lint import (
+            ProgramRecord,
+        )
+
+        source = "deeplearning4j_tpu/parallel/inference.py"
+        records = []
+        cache = getattr(self.net, "_jit_cache", None)
+        fn = cache.get("predict") if cache is not None else None
+        shapes = self._warmup_shapes()
+        if fn is not None and shapes:
+            b = max(self._warmed_buckets or [self._cap])
+            xs = [np.zeros((b,) + s, np.float32) for s in shapes]
+            names = getattr(self.net.conf, "network_inputs", None)
+            if names:   # ComputationGraph predict takes {name: x}
+                args = (self.net.params, self.net.states,
+                        dict(zip(names, xs)))
+            else:
+                args = (self.net.params, self.net.states, xs[0])
+            records.append(ProgramRecord(
+                name="serving_predict", fn=getattr(fn, "__wrapped__", fn),
+                example_args=args,
+                precision_policy=(cache.policy("predict")
+                                  if hasattr(cache, "policy") else None),
+                source=source))
+        for b, agg in self.bucket_fill().items():
+            records.append(ProgramRecord(
+                name=f"serving_bucket_{b}", source=source,
+                bucket_capacity=b,
+                bucket_rows_per_dispatch=(
+                    agg["rows"] / agg["dispatches"]
+                    if agg["dispatches"] else 0.0)))
+        return records
+
     def stats(self) -> dict:
         """Pipeline + compile-guard facts (surfaced on /status)."""
         out = {
@@ -328,6 +379,7 @@ class ParallelInference:
             "requests_completed": self._requests_completed,
             "bucket_cap": self._cap,
             "warmed_buckets": list(self._warmed_buckets),
+            "bucket_fill": self.bucket_fill(),
             "current_wait_ms": round(self._wait_ms, 4),
             "adaptive_wait": self.adaptive_wait,
         }
@@ -731,6 +783,9 @@ class ParallelInference:
                         dspan.end(error=type(e).__name__)
                     continue
                 self._batches_dispatched += 1
+                agg = self._bucket_fill.setdefault(keys[0][0], [0, 0])
+                agg[0] += 1
+                agg[1] += rows
                 _obs.count_observe(
                     "dl4j_serving_batches_total",
                     "dl4j_serving_batch_occupancy", rows,

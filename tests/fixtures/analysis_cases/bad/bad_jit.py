@@ -6,14 +6,27 @@
 - `fit` passes xs.shape[0] and len(xs) to a jitted callable
   -> jit-traced-python-scalar
 - `fit` reads `params` after donating it -> jit-use-after-donation
+- `fused_update` is step-shaped, jitted through the module-level
+  `jit = functools.partial(jax.jit)` alias without donation
+  -> jit-missing-donate (the previously-missed alias form)
 """
 
+import functools
+
 import jax
+
+jit = functools.partial(jax.jit)
 
 
 def step_fn(params, x):
     return params
 
+
+def fused_update_fn(params, g):
+    return params
+
+
+fused_update = jit(fused_update_fn)
 
 train_step = jax.jit(step_fn)
 

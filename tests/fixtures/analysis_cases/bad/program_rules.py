@@ -1,0 +1,20 @@
+"""Program-rule registry violations (true positives; parsed only).
+
+- `Rule("prog-bogus-rule", ...)` is declared in a catalog but missing
+  from REGISTERED_PROGRAM_RULES -> reg-unregistered-program-rule
+- REGISTERED_PROGRAM_RULES pins "prog-phantom-rule" which no Rule(...)
+  defines -> reg-unimplemented-program-rule
+"""
+
+
+def Rule(rule_id, pass_name, description):
+    return (rule_id, pass_name, description)
+
+
+REGISTERED_PROGRAM_RULES = frozenset({
+    "prog-phantom-rule",
+})
+
+_RULE_LIST = [
+    Rule("prog-bogus-rule", "program", "declared but never registered"),
+]

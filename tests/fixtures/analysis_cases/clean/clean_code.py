@@ -5,22 +5,35 @@ step, registered fault point and metric, annotated guard swallow —
 the analyzer must report NOTHING here.
 """
 
+import functools
 import threading
 
 import jax
 
 from deeplearning4j_tpu.observability import metrics as _obs
 
+# module-level partial alias WITH donation: alias call sites inherit
+# the partial's kwargs, so this is a clean jit site (guard for the
+# alias-recognition satellite)
+jit_donated = functools.partial(jax.jit, donate_argnums=(0,))
+
 
 def step_fn(params, x):
     return params
 
+
+def alias_update_fn(params, g):
+    return params
+
+
+alias_update = jit_donated(alias_update_fn)
 
 train_step = jax.jit(step_fn, donate_argnums=(0,))
 
 
 def fit(params, xs):
     params = train_step(params, xs)
+    params = alias_update(params, xs)
     fire("clean.point")             # noqa: F821
     _obs.count("dl4j_train_clean_total")
     return params

@@ -1,0 +1,15 @@
+"""Consistent program-rule registry (false-positive guard): every
+Rule("prog-...") is pinned and every pinned id has a Rule."""
+
+
+def Rule(rule_id, pass_name, description):
+    return (rule_id, pass_name, description)
+
+
+REGISTERED_PROGRAM_RULES = frozenset({
+    "prog-consistent-rule",
+})
+
+_RULE_LIST = [
+    Rule("prog-consistent-rule", "program", "pinned and defined"),
+]

@@ -542,6 +542,35 @@ def test_perf_gate_verdicts(tmp_path, capsys):
     assert gate.main(["--dir", str(solo)]) == 2
 
 
+def test_perf_gate_skips_when_newer_record_lacks_keys(tmp_path,
+                                                      capsys):
+    """A newer BENCH record missing a metric key the older one has is
+    a comparability gap (the bench grew/renamed a field), not a
+    regression: SKIP (exit 2), never FAIL (exit 1)."""
+    gate = _load_perf_gate()
+    old = tmp_path / "BENCH_r01.json"
+    old.write_text(json.dumps({"metric": "m", "value": 100.0}))
+    # newer record emits a renamed field set: no "value" yet
+    new = tmp_path / "BENCH_r02.json"
+    new.write_text(json.dumps({"metric": "m",
+                               "examples_per_sec": 97.0}))
+    assert gate.main(["--dir", str(tmp_path)]) == 2
+    out = capsys.readouterr().out
+    assert "PERF GATE SKIP" in out and "value" in out
+    # missing "metric" in the newer record skips the same way
+    new.write_text(json.dumps({"value": 97.0}))
+    assert gate.main([str(old), str(new)]) == 2
+    assert "PERF GATE SKIP" in capsys.readouterr().out
+    # and an OLDER record that is short a key still ERRORs (the gap is
+    # only forgiven in the newer direction)
+    old2 = tmp_path / "old2.json"
+    old2.write_text(json.dumps({"metric": "m"}))
+    new2 = tmp_path / "new2.json"
+    new2.write_text(json.dumps({"metric": "m", "value": 5.0}))
+    assert gate.main([str(old2), str(new2)]) == 2
+    assert "PERF GATE ERROR" in capsys.readouterr().out
+
+
 # ============================================== concurrency sanity
 def test_jit_cache_forensics_thread_safe():
     """Concurrent calls through the shim never corrupt the ring or

@@ -8,6 +8,7 @@ including a real A->B / B->A cycle across two threads.
 """
 
 import json
+import os
 import runpy
 import subprocess
 import sys
@@ -41,19 +42,27 @@ CLEAN = TESTS / "fixtures" / "analysis_cases" / "clean"
 
 
 # ==================================================== rule catalog
-def test_rule_catalog_covers_three_passes():
+def test_rule_catalog_covers_four_passes():
     by_pass = {}
     for r in RULES.values():
         by_pass.setdefault(r.pass_name, []).append(r.id)
         assert r.description
     static_rules = sum(len(v) for k, v in by_pass.items()
-                       if k != "runtime")
+                       if k not in ("runtime", "program"))
     assert static_rules >= 8, by_pass
     assert set(by_pass) == {"jit", "concurrency", "conformance",
-                            "runtime"}
+                            "program", "runtime"}
     # the runtime sanitizer rules ride the same catalog
     assert "san-lock-order-cycle" in RULES
     assert "san-long-held-lock" in RULES
+    # the program-pass catalog IS the pinned registry (and vice versa:
+    # conformance re-checks this equality from the AST, so the pin
+    # holds even for a build that never imports program_lint)
+    from deeplearning4j_tpu.analysis.program_lint import (
+        REGISTERED_PROGRAM_RULES,
+    )
+
+    assert set(by_pass["program"]) == set(REGISTERED_PROGRAM_RULES)
 
 
 # ============================================== tier-1: tree is clean
@@ -88,6 +97,8 @@ EXPECTED_BAD = {
     "reg-unregistered-metric": "bad_registry.py",
     "reg-unemitted-metric": "metrics.py",
     "reg-swallowed-exception": "bad_registry.py",
+    "reg-unregistered-program-rule": "program_rules.py",
+    "reg-unimplemented-program-rule": "program_rules.py",
 }
 
 
@@ -115,6 +126,12 @@ def test_bad_fixture_exact_shape():
     # the two traced-scalar shapes (x.shape[i], len()) both fire
     assert sum(1 for f in finds
                if f.rule == "jit-traced-python-scalar") == 2
+    # the module-level `jit = functools.partial(jax.jit)` alias call
+    # site is a recognized jit site: the step-shaped fn it wraps
+    # without donation fires jit-missing-donate (satellite)
+    assert any(f.rule == "jit-missing-donate"
+               and f.symbol == "fused_update_fn" for f in finds), \
+        [f.render() for f in finds if f.rule == "jit-missing-donate"]
     # the reachability guard: cold_helper's .item() is NOT flagged
     assert not any(f.rule == "jit-host-sync"
                    and f.symbol == "cold_helper" for f in finds)
@@ -506,6 +523,153 @@ def test_reachability_falls_back_to_names_when_unresolvable(tmp_path):
                 pass
         """, tmp_path)
     assert "pkg/mod.py::Elsewhere.launch" in seen
+
+
+# ============================== pass 4: compiled-program lint (jaxpr/HLO)
+PROGRAMS_FIX = TESTS / "fixtures" / "analysis_cases" / "programs"
+
+# one bad fixture record per pinned program rule — this dict also
+# keeps every REGISTERED_PROGRAM_RULES id named by a test (the
+# reg-untested-registry-name discipline):
+#   prog-fp32-matmul-under-policy, prog-unhonored-donation,
+#   prog-transpose-churn, prog-hidden-host-transfer,
+#   prog-dead-output, prog-excess-padding
+EXPECTED_BAD_PROGRAMS = {
+    "prog-fp32-matmul-under-policy": "bad_fp32_matmul",
+    "prog-unhonored-donation": "bad_unhonored_donation",
+    "prog-transpose-churn": "bad_transpose_churn",
+    "prog-hidden-host-transfer": "bad_host_transfer",
+    "prog-dead-output": "bad_dead_output",
+    "prog-excess-padding": "bad_excess_padding",
+}
+
+
+def _program_fixture_records(name):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        f"analysis_programs_{name}", PROGRAMS_FIX / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.build_records()
+
+
+def _program_findings(name):
+    from deeplearning4j_tpu.analysis import program_lint
+
+    return program_lint.run(_program_fixture_records(name))
+
+
+@pytest.mark.parametrize("rule,program",
+                         sorted(EXPECTED_BAD_PROGRAMS.items()))
+def test_bad_program_fixture_true_positive(rule, program):
+    finds = _program_findings("bad_programs")
+    hits = [f for f in finds if f.rule == rule]
+    assert hits, f"{rule} found nothing in the bad program fixtures"
+    assert any(f.symbol == program for f in hits), \
+        [f.render() for f in hits]
+    for f in hits:
+        assert f.message and "line" not in f.message
+
+
+def test_bad_program_fixture_exact_shape():
+    """Every finding accounted for; no rule fires on the wrong
+    program (over-match guard), and fingerprints are stable."""
+    finds = _program_findings("bad_programs")
+    got = {(f.rule, f.symbol) for f in finds}
+    assert got == set(EXPECTED_BAD_PROGRAMS.items()), got
+    assert all(f.fingerprint() for f in finds)
+
+
+def test_clean_program_fixture_no_findings():
+    finds = _program_findings("clean_programs")
+    assert finds == [], [f.render() for f in finds]
+
+
+def test_program_findings_ride_the_baseline_machinery():
+    """prog-* findings fingerprint/baseline exactly like AST findings:
+    a baselined program violation suppresses, a fixed one goes stale."""
+    finds = _program_findings("bad_programs")
+    bl = Baseline.from_findings(finds)
+    new, suppressed, stale = bl.apply(finds)
+    assert not new and len(suppressed) == len(finds) and not stale
+    new2, _, stale2 = bl.apply(finds[1:])
+    assert not new2 and len(stale2) == 1
+
+
+def test_flagship_program_clean_pin():
+    """THE acceptance pin: the flagship bench program (and the
+    published graft entry) carry no prog-unhonored-donation and no
+    prog-fp32-matmul-under-policy finding under the declared bf16
+    policy."""
+    from deeplearning4j_tpu.analysis import program_lint, programs
+
+    records = programs._flagship_records()
+    names = {r.name for r in records}
+    assert {"bench_flagship_k_steps", "graft_entry_forward"} <= names
+    assert all(r.precision_policy == "bf16" for r in records)
+    finds = program_lint.run(records)
+    bad = [f for f in finds
+           if f.rule in ("prog-unhonored-donation",
+                         "prog-fp32-matmul-under-policy")]
+    assert bad == [], [f.render() for f in bad]
+
+
+def test_engine_and_serving_records_declare_policy():
+    """StepProgram and the serving front-end register the explicit
+    precision_policy fact the lint checks against — on a bf16 net the
+    records say bf16, and the net's JitCache carries the policy for
+    every registered program key."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu import (
+        MultiLayerNetwork,
+        NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.engine import StepProgram
+    from deeplearning4j_tpu.nn.conf import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    conf = (NeuralNetConfiguration.Builder().seed(1).updater("sgd")
+            .learning_rate(0.1).activation("relu")
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=4, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6)).build())
+    net = MultiLayerNetwork(conf, compute_dtype="bfloat16").init()
+    prog = StepProgram(net)
+    assert prog.precision_policy == "bf16"
+    recs = prog.lint_records(jnp.zeros((4, 6), jnp.float32),
+                             jnp.zeros((4, 4), jnp.float32), k=2)
+    assert [r.name for r in recs] == ["engine_single",
+                                     "engine_single_group_k2"]
+    assert all(r.precision_policy == "bf16" for r in recs)
+    policies = net._jit_cache.policies()
+    assert policies and all(v == "bf16" for v in policies.values())
+    # f32 default stays declared too — never a guess
+    net2 = MultiLayerNetwork(conf).init()
+    assert StepProgram(net2).precision_policy == "f32"
+
+
+def test_cli_programs_mode_clean_under_60s():
+    """`dl4j-analyze --programs` runs the whole representative program
+    set on CPU, ends at zero findings with the EMPTY shipped baseline,
+    in under 60 seconds (acceptance criterion)."""
+    t0 = time.perf_counter()
+    p = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "analyze.py"),
+         "--programs"],
+        cwd=ROOT, capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    elapsed = time.perf_counter() - t0
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "0 new finding(s)" in p.stdout
+    assert "programs" in p.stdout
+    assert elapsed < 60.0, f"--programs took {elapsed:.1f}s"
+    # the shipped baseline stays EMPTY: program findings may never be
+    # suppressed into it
+    data = json.loads(BASELINE.read_text())
+    assert data["suppressions"] == []
 
 
 def test_engine_entry_points_are_reachability_roots():

@@ -1,20 +1,29 @@
 #!/usr/bin/env python3
 """dl4j-analyze CLI — static invariant checker for deeplearning4j_tpu.
 
-Zero-dependency: loads ONLY deeplearning4j_tpu/analysis/* (stdlib +
-ast), never the package __init__ (which would pull in jax). The
-analyzed code is parsed, not imported, so this runs in under a second
-in a bare interpreter — fast enough for a pre-commit hook:
+Zero-dependency by default: loads ONLY deeplearning4j_tpu/analysis/*
+(stdlib + ast), never the package __init__ (which would pull in jax).
+The analyzed code is parsed, not imported, so this runs in under a
+second in a bare interpreter — fast enough for a pre-commit hook:
 
     python tools/analyze.py            # whole tree vs the baseline
     python tools/analyze.py --diff     # only files changed vs HEAD
     python tools/analyze.py --rules    # rule catalog
     python tools/analyze.py --catalog  # thread/lock census
+    python tools/analyze.py --programs # pass 4: compiled-program lint
+
+`--programs` is the one mode that DOES import jax (pinned to
+JAX_PLATFORMS=cpu): it builds the representative compiled-program set
+(analysis/programs.py) and lints jaxprs / lowered modules / compiled
+HLO against each program's declared precision policy, donation map,
+consumed outputs, and bucket fill (analysis/program_lint.py). The
+whole set runs in well under 60s on CPU.
 
 Exit codes: 0 clean (vs tools/analyze_baseline.json), 1 new findings,
 2 usage error.
 """
 
+import os
 import sys
 import types
 from pathlib import Path
@@ -36,5 +45,11 @@ def _load_analysis_package():
 
 if __name__ == "__main__":
     sys.path.insert(0, str(ROOT))
-    runner = _load_analysis_package()
+    if "--programs" in sys.argv[1:]:
+        # program mode executes the real package (it builds nets and
+        # serving front-ends); pin the platform before jax loads
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from deeplearning4j_tpu.analysis import runner
+    else:
+        runner = _load_analysis_package()
     sys.exit(runner.main())

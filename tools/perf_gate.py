@@ -99,8 +99,24 @@ def main(argv=None) -> int:
         prev_path, new_path = rounds[-2], rounds[-1]
 
     try:
-        verdict = compare(load_round(prev_path), load_round(new_path),
-                          args.tolerance)
+        prev_doc, new_doc = load_round(prev_path), load_round(new_path)
+    except OSError as e:
+        print(f"PERF GATE ERROR: {e}")
+        return 2
+    # a NEWER record missing a key the older one has means the bench
+    # grew/renamed a field this round — that is a comparability gap,
+    # not a regression: SKIP (exit 2) so new bench fields never
+    # spuriously gate a perf PR
+    missing = [k for k in ("metric", "value")
+               if k in prev_doc and k not in new_doc]
+    if missing:
+        print(f"PERF GATE SKIP: newer record "
+              f"{os.path.basename(new_path)} lacks "
+              f"{'/'.join(missing)} present in "
+              f"{os.path.basename(prev_path)} — not comparable")
+        return 2
+    try:
+        verdict = compare(prev_doc, new_doc, args.tolerance)
     except (OSError, ValueError, KeyError) as e:
         print(f"PERF GATE ERROR: {e}")
         return 2
