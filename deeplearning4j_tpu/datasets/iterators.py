@@ -372,3 +372,27 @@ class DevicePrefetchIterator(DataSetIterator):
             except StopIteration:
                 self._src_done = True
         return out
+
+    # -------------------------------------------------------- shutdown
+    def close(self, timeout_s: float = 5.0) -> None:
+        """Stop the pipeline: drop the staged device buffer (donation
+        safety — a staged batch that was never consumed is discarded,
+        never re-yielded) and propagate close() to `base`, so wrapping
+        an AsyncDataSetIterator no longer hides its producer thread
+        from StepHarness.attach_data's `hasattr(source, "close")`
+        check. Idempotent and non-terminal: a later __iter__()/reset()
+        starts a fresh pass."""
+        self._src = None
+        self._staged = None
+        self._src_done = False
+        if hasattr(self.base, "close"):
+            try:
+                self.base.close(timeout_s=timeout_s)
+            except TypeError:   # base close() without a timeout param
+                self.base.close()
+
+    def __enter__(self) -> "DevicePrefetchIterator":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

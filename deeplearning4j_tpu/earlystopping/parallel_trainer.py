@@ -12,12 +12,20 @@ from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
 class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
     def __init__(self, config, net, train_iterator, workers=None,
                  tp: int = 1, mesh=None, averaging_frequency: int = 1,
-                 guard=None):
-        super().__init__(config, net, train_iterator, guard=guard)
+                 guard=None, pipeline=None):
+        super().__init__(config, net, train_iterator, guard=guard,
+                         pipeline=pipeline)
+        # the trainer's own pipeline already overlaps ETL; the inner
+        # wrapper fits tiny buffered groups, where spinning up a
+        # producer thread per flush would cost more than it hides
         self.wrapper = ParallelWrapper(
             net, workers=workers, tp=tp, mesh=mesh,
-            averaging_frequency=averaging_frequency)
+            averaging_frequency=averaging_frequency, pipeline=False)
         self._group = []
+
+    def _pipeline_host_only(self) -> bool:
+        # buffered batches are re-padded/stacked on host by the wrapper
+        return True
 
     def _fit_batch(self, batch):
         # buffer to the wrapper's averaging frequency so local-SGD

@@ -17,7 +17,8 @@ logger = logging.getLogger("deeplearning4j_tpu")
 
 class EarlyStoppingTrainer:
     def __init__(self, config, net, train_iterator, guard=None,
-                 snapshot_every: int = 0):
+                 snapshot_every: int = 0,
+                 pipeline=None, pipeline_depth: int = 2):
         """`guard` (resilience.NonFiniteGuard) checks the net after
         (sampled) training batches: a non-finite/spiking batch is
         skipped with the pre-batch state restored (policy='skip_step')
@@ -45,12 +46,30 @@ class EarlyStoppingTrainer:
         self.config = config
         self.net = net
         self.train_iterator = train_iterator
+        # harness-owned input pipeline (engine/pipeline.py): async ETL
+        # + double-buffered device staging ahead of fit_batch. Default
+        # (None): ON for single-process jobs; pipeline=False opts out.
+        self.pipeline = pipeline
+        self.pipeline_depth = max(1, int(pipeline_depth))
         # the shared supervisor (engine/): one guard-verdict dispatch
         # for all three fit entry points; this trainer's rollback
         # target is the in-memory snapshotter
         self._harness = StepHarness(net, guard=guard,
                                     snapshotter=self._snapshotter)
         self.guard = self._harness.guard
+
+    def _pipeline_enabled(self) -> bool:
+        if self.pipeline is not None:
+            return bool(self.pipeline)
+        import jax
+
+        return jax.process_count() == 1
+
+    def _pipeline_host_only(self) -> bool:
+        """Device staging suits the plain trainer (fit_batch consumes
+        the staged tuple directly); the parallel trainer re-buffers
+        host batches for its wrapper and overrides this to True."""
+        return False
 
     def _fit_batch(self, batch):
         """One training batch through the shared StepProgram (full
@@ -93,7 +112,13 @@ class EarlyStoppingTrainer:
         # shared session lifecycle: flush + close the train iterator's
         # prefetch thread (AsyncDataSetIterator.close) even when a
         # termination condition or the guard aborts the fit
-        self._harness.attach_data(self.train_iterator)
+        self._data = self.train_iterator
+        if self._pipeline_enabled():
+            self._data = self._harness.build_iterator_pipeline(
+                self.train_iterator, depth=self.pipeline_depth,
+                host_only=self._pipeline_host_only())
+        else:
+            self._harness.attach_data(self.train_iterator)
         with self._harness.session():
             reason, details, best_score, best_epoch, epoch = \
                 self._fit_epochs(cfg, net, score_vs_epoch, best_score,
@@ -115,11 +140,12 @@ class EarlyStoppingTrainer:
 
     def _fit_epochs(self, cfg, net, score_vs_epoch, best_score,
                     best_epoch, epoch, reason, details):
+        data = getattr(self, "_data", self.train_iterator)
         while reason is None:
             net.epoch = epoch
-            if hasattr(self.train_iterator, "reset"):
-                self.train_iterator.reset()
-            for batch in self.train_iterator:
+            if hasattr(data, "reset"):
+                data.reset()
+            for batch in data:
                 if not self._fit_batch_guarded(batch):
                     continue   # guard rejected the batch: state restored
                 score = net.score()

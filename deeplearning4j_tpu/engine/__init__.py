@@ -32,12 +32,26 @@ single step program to shard. Two halves:
                 teardown (flush, stop, close attached data iterators).
                 TrainingMaster, ParallelWrapper, and
                 EarlyStoppingTrainer are thin adapters over it.
+  pipeline      the harness-owned input pipeline (engine/pipeline.py):
+                StepPrefetcher / IteratorPipeline run fetch + h2d
+                staging ahead of the compute on a producer thread so
+                `data_wait`/`h2d` overlap `device_compute` — built and
+                torn down by the harness session, opt-out per entry
+                point via `pipeline=False`.
 """
 
 from deeplearning4j_tpu.engine.harness import StepHarness
+from deeplearning4j_tpu.engine.pipeline import (
+    SKIPPED,
+    IteratorPipeline,
+    StepPrefetcher,
+    stack_staged,
+)
 from deeplearning4j_tpu.engine.step_program import (
     StepProgram,
     make_loss_and_apply,
 )
 
-__all__ = ["StepProgram", "StepHarness", "make_loss_and_apply"]
+__all__ = ["StepProgram", "StepHarness", "make_loss_and_apply",
+           "StepPrefetcher", "IteratorPipeline", "stack_staged",
+           "SKIPPED"]

@@ -82,6 +82,8 @@ class StepHarness:
         self._guard_steps = 0
         self._step_span = None
         self._closeables = []
+        self._pipeline = None
+        self._pipeline_meta = None
 
     # ------------------------------------------------------- lifecycle
     def attach_data(self, source) -> None:
@@ -115,6 +117,56 @@ class StepHarness:
                 self.preemption.uninstall()
             if close_data:
                 self.close_data()
+
+    # -------------------------------------------------- input pipeline
+    def build_step_pipeline(self, fetch, *, start=0, stop=None,
+                            depth=2, skip=None, meta=None):
+        """Own a StepPrefetcher for a batch_fn-driven fit loop: the
+        producer runs fetch→retry/skip→stage ahead of the compute so
+        `data_wait`/`h2d` overlap `device_compute`; the session
+        teardown joins its producer like any attached data source.
+        `meta` records derivation facts (live world, sharding) for the
+        `pipeline` block of training_stats()."""
+        from deeplearning4j_tpu.engine.pipeline import StepPrefetcher
+
+        p = StepPrefetcher(fetch, start=start, stop=stop, depth=depth,
+                           skip=skip)
+        self.attach_data(p)
+        self._pipeline = p
+        self._pipeline_meta = dict(meta or {})
+        return p
+
+    def build_iterator_pipeline(self, source, *, depth=2, queue_size=4,
+                                stage=None, sharding=None,
+                                host_only=False, meta=None):
+        """Own an IteratorPipeline (AsyncDataSetIterator →
+        DevicePrefetchIterator) for an iterator-driven fit loop; the
+        session teardown closes the whole chain (the wrapped producer
+        thread is joined — the close() DevicePrefetchIterator used to
+        hide)."""
+        from deeplearning4j_tpu.engine.pipeline import IteratorPipeline
+
+        p = IteratorPipeline(source, depth=depth,
+                             queue_size=queue_size, stage=stage,
+                             sharding=sharding, host_only=host_only)
+        self.attach_data(p)
+        self._pipeline = p
+        self._pipeline_meta = dict(meta or {})
+        return p
+
+    def pipeline_stats(self):
+        """The `pipeline` facts block for training_stats(): None when
+        no harness-owned pipeline was built, else its counters plus the
+        derivation metadata recorded at build time (facts survive the
+        session teardown — the pipeline object keeps its counters after
+        close)."""
+        if self._pipeline is None:
+            return None
+        out = {"enabled": True}
+        out.update(self._pipeline.facts())
+        if self._pipeline_meta:
+            out.update(self._pipeline_meta)
+        return out
 
     def close_data(self) -> None:
         """Close attached data sources (idempotent, exception-proof:

@@ -97,6 +97,11 @@ REGISTERED_METRICS = frozenset({
     "dl4j_perf_program_bytes",
     "dl4j_perf_arithmetic_intensity",
     "dl4j_train_phase_seconds",
+    # harness-owned input pipeline (engine/pipeline.py)
+    "dl4j_pipeline_batches_total",
+    "dl4j_pipeline_wait_seconds",
+    "dl4j_pipeline_reseeks_total",
+    "dl4j_pipeline_depth",
     # resilience plumbing
     "dl4j_retry_attempts_total",
     "dl4j_breaker_transitions_total",

@@ -84,7 +84,9 @@ class TrainingMaster:
                  tracer=None,
                  phase_profiler=None,
                  steps_per_dispatch: int = 1,
-                 per_rank_checkpoints: bool = False):
+                 per_rank_checkpoints: bool = False,
+                 pipeline: Optional[bool] = None,
+                 pipeline_depth: int = 2):
         """`averaging_frequency=k > 1` runs k-step local SGD between
         parameter rendezvous — each dp shard trains privately for k
         steps, then params (+ updater state) are averaged. This is the
@@ -177,6 +179,15 @@ class TrainingMaster:
                 "steps_per_dispatch > 1 and averaging_frequency > 1 "
                 "are mutually exclusive groupings (the local-SGD "
                 "rendezvous already scans its k steps in one dispatch)")
+        # harness-owned input pipeline (engine/pipeline.py): a producer
+        # thread runs fetch -> retry/skip -> poison -> h2d staging
+        # ahead of the compute so data_wait/h2d overlap device_compute.
+        # Default (None): ON for single-process jobs, OFF multi-host
+        # (cross-rank staging order stays on the consumer thread until
+        # the sharded scale-out arc); pipeline=False opts out.
+        self.pipeline = pipeline
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        self._prefetch = None
         self._staged = False
         self._local_step = None
         # ONE supervisor (engine.StepHarness) owns the guard-verdict
@@ -370,6 +381,15 @@ class TrainingMaster:
             # step — seed one at the fit's starting state
             self.save_checkpoint(start_step)
         with self._harness.session():
+            self._prefetch = None
+            if self._pipeline_enabled():
+                self._prefetch = self._harness.build_step_pipeline(
+                    lambda s: self._produce(batch_fn, s),
+                    start=start_step, stop=num_steps,
+                    depth=self.pipeline_depth,
+                    skip=self._poisoned_steps.__contains__,
+                    meta={"sharding": "dp",
+                          "world": self.world_info()})
             if self.averaging_frequency > 1:
                 return self._fit_local_sgd(batch_fn, num_steps,
                                            start_step,
@@ -407,12 +427,10 @@ class TrainingMaster:
         harness.beat("dispatch", step=step)
         harness.mark("data_wait")
         t0 = time.perf_counter()
-        batch = self._next_batch(batch_fn, step)
-        if batch is None:       # bad batch skipped by policy
+        staged = self._fetch_step(batch_fn, step)
+        if staged is None:      # bad batch skipped by policy
             return step + 1
-        harness.mark("h2d")
-        x, y = self._global_batch(
-            self._maybe_poison(batch[0]), batch[1])
+        x, y = staged
         t1 = time.perf_counter()
         if tr is not None:
             tr.record("fetch_and_stage", t0, t1, cat="train", parent=sp)
@@ -486,11 +504,107 @@ class TrainingMaster:
             })
         return step + 1
 
+    # --------------------------------------------------- input pipeline
+    def _pipeline_enabled(self) -> bool:
+        """Pipeline resolution: explicit flag wins; default ON for
+        single-process jobs, OFF multi-host (every rank's staging must
+        stay in the consumer's program order until the sharded
+        scale-out arc makes cross-rank staging explicit)."""
+        if self.pipeline is not None:
+            return bool(self.pipeline)
+        import jax
+
+        return jax.process_count() == 1
+
+    def _produce(self, batch_fn, step):
+        """Producer-side work for ONE step (runs on the prefetch
+        thread): the `data.next` fault point + `data_retry`/
+        `skip_bad_batches` policy, chaos poisoning, and the h2d staging
+        itself — a poisoned batch condemns the right step, and the copy
+        of step k+1 overlaps compute on step k. Returns staged (x, y)
+        global arrays sharded over the LIVE mesh's dp axis, or SKIPPED
+        when the skip policy consumed the failure."""
+        from deeplearning4j_tpu.engine.pipeline import SKIPPED
+
+        b = self._next_batch(batch_fn, step, observe=False)
+        if b is None:
+            return SKIPPED
+        return self._global_batch(self._maybe_poison(b[0]), b[1])
+
+    def _fetch_step(self, batch_fn, step):
+        """Staged (x, y) device arrays for `step`, or None when the
+        step was skipped by policy — through the harness-owned
+        prefetcher when the pipeline is on (fetch + h2d already
+        overlapped earlier compute; the residual wait is what
+        data_wait shrinks to), else fetched + staged synchronously."""
+        harness = self._harness
+        if self._prefetch is not None:
+            t0 = time.perf_counter()
+            out = self._prefetch.get(step)
+            harness.mark("h2d")
+            if out is None:
+                return None
+            self._obs_acc.observe("dl4j_train_data_wait_seconds",
+                                  time.perf_counter() - t0)
+            return out
+        batch = self._next_batch(batch_fn, step)
+        if batch is None:
+            return None
+        harness.mark("h2d")
+        return self._global_batch(
+            self._maybe_poison(batch[0]), batch[1])
+
+    def _fetch_window(self, batch_fn, step, span):
+        """(group, abs_steps) for a k-window's non-poisoned steps —
+        pipeline on: staged (x, y) device pairs; off: host pairs. The
+        per-inner-step ordering (and therefore the fault-point hit →
+        step mapping) is identical in both modes."""
+        group, abs_steps = [], []
+        for s in range(step, step + span):
+            if s in self._poisoned_steps:
+                continue   # rollback replay: skip poisoned data
+            if self._prefetch is not None:
+                t0 = time.perf_counter()
+                out = self._prefetch.get(s)
+                if out is None:
+                    continue
+                self._obs_acc.observe("dl4j_train_data_wait_seconds",
+                                      time.perf_counter() - t0)
+                group.append(out)
+                abs_steps.append(s)
+            else:
+                b = self._next_batch(batch_fn, s)
+                if b is not None:
+                    group.append((self._maybe_poison(b[0]), b[1]))
+                    abs_steps.append(s)
+        return group, abs_steps
+
+    def _stack_window(self, group):
+        """[k] batch pairs -> ([k, G, ...], [k, G, ...]) staged with
+        P(None, 'dp'). Pipeline entries stack on DEVICE (stack_staged —
+        no host np.stack copy of the k-window); host entries stack then
+        stage. Same values, same sharding, same compiled program."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if self._prefetch is not None:
+            from deeplearning4j_tpu.engine.pipeline import stack_staged
+
+            sh = NamedSharding(self.mesh, P(None, "dp"))
+            return (stack_staged([g[0] for g in group], sh),
+                    stack_staged([g[1] for g in group], sh))
+        return (self._stage(np.stack([g[0] for g in group]),
+                            P(None, "dp")),
+                self._stage(np.stack([g[1] for g in group]),
+                            P(None, "dp")))
+
     # ------------------------------------------------------- self-healing
-    def _next_batch(self, batch_fn, step):
+    def _next_batch(self, batch_fn, step, observe: bool = True):
         """Fetch this step's batch through the `data.next` fault point,
         retried per `data_retry`; returns None (skip the step) when the
-        fetch ultimately fails and `skip_bad_batches` is set."""
+        fetch ultimately fails and `skip_bad_batches` is set.
+        `observe=False` on the pipeline's producer thread: the
+        StepAccumulator is single-owner, so the consumer observes its
+        own (residual) wait instead."""
         def get():
             _fire("data.next")
             return batch_fn(step)
@@ -511,8 +625,9 @@ class TrainingMaster:
                                "skipped (skip_bad_batches)", step)
                 return None
             raise
-        self._obs_acc.observe("dl4j_train_data_wait_seconds",
-                              time.perf_counter() - t_fetch)
+        if observe:
+            self._obs_acc.observe("dl4j_train_data_wait_seconds",
+                                  time.perf_counter() - t_fetch)
         return out
 
     def _maybe_poison(self, x):
@@ -547,8 +662,6 @@ class TrainingMaster:
         poisoned inner step and the window replays without it — same
         granularity contract as the local-SGD `guard_inner_steps`
         path, now the default for engine groups."""
-        from jax.sharding import PartitionSpec as P
-
         net = self.net
         guard = self.guard
         harness = self._harness
@@ -570,24 +683,14 @@ class TrainingMaster:
                     pp.mark("data_wait")
                 t0 = time.perf_counter()
                 span = min(step + k, num_steps) - step
-                group = []
-                abs_steps = []     # group index -> global step
-                for s in range(step, step + span):
-                    if s in self._poisoned_steps:
-                        continue   # rollback replay: skip poisoned data
-                    b = self._next_batch(batch_fn, s)
-                    if b is not None:
-                        group.append((self._maybe_poison(b[0]), b[1]))
-                        abs_steps.append(s)
+                group, abs_steps = self._fetch_window(
+                    batch_fn, step, span)
                 if not group:
                     step += span
                     continue
                 if pp is not None:
                     pp.mark("h2d")
-                xs = self._stage(np.stack([g[0] for g in group]),
-                                 P(None, "dp"))
-                ys = self._stage(np.stack([g[1] for g in group]),
-                                 P(None, "dp"))
+                xs, ys = self._stack_window(group)
                 t1 = time.perf_counter()
                 # guard at group granularity: one check per dispatch
                 # (already a 1/k sampling of the underlying steps)
@@ -695,8 +798,6 @@ class TrainingMaster:
         shard_map program; data stacked [k, G, ...] per group."""
         import time
 
-        from jax.sharding import PartitionSpec as P
-
         from deeplearning4j_tpu.parallel.wrapper import LocalStepTrainer
 
         net = self.net
@@ -727,24 +828,14 @@ class TrainingMaster:
                     pp.mark("data_wait")
                 t0 = time.perf_counter()
                 span = min(step + k, num_steps) - step
-                group = []
-                abs_steps = []     # group index -> global step
-                for s in range(step, step + span):
-                    if s in self._poisoned_steps:
-                        continue   # rollback replay: skip poisoned data
-                    b = self._next_batch(batch_fn, s)
-                    if b is not None:
-                        group.append((self._maybe_poison(b[0]), b[1]))
-                        abs_steps.append(s)
+                group, abs_steps = self._fetch_window(
+                    batch_fn, step, span)
                 if not group:
                     step += span
                     continue
                 if pp is not None:
                     pp.mark("h2d")
-                xs = self._stage(np.stack([g[0] for g in group]),
-                                 P(None, "dp"))
-                ys = self._stage(np.stack([g[1] for g in group]),
-                                 P(None, "dp"))
+                xs, ys = self._stack_window(group)
                 t1 = time.perf_counter()
                 # guard at group granularity: one check per rendezvous
                 # (already a 1/k sampling of the underlying steps)
@@ -871,17 +962,18 @@ class TrainingMaster:
         prof = self._profiler_stats()
         phases = (self.phase_profiler.report()
                   if self.phase_profiler is not None else None)
+        pipe = self._harness.pipeline_stats()
         if not stats:
             return {"steps": [], "summary": {}, "wire": wire,
                     "resilience": resil, "profiler": prof,
-                    "phases": phases}
+                    "phases": phases, "pipeline": pipe}
         summary = {
             k: float(np.mean([s[k] for s in stats]))
             for k in ("data_ms", "fit_ms", "listener_ms", "checkpoint_ms")
         }
         return {"steps": stats, "summary": summary, "wire": wire,
                 "resilience": resil, "profiler": prof,
-                "phases": phases}
+                "phases": phases, "pipeline": pipe}
 
     def _profiler_stats(self):
         """Surface an attached ProfilerListener's device-trace facts

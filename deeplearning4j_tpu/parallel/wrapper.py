@@ -76,7 +76,9 @@ class ParallelWrapper:
                  mesh: Optional[Mesh] = None, prefetch_buffer: int = 2,
                  threshold_compression: float = 0.0,
                  guard=None, watchdog=None, snapshot_every: int = 0,
-                 phase_profiler=None):
+                 phase_profiler=None,
+                 steps_per_dispatch: int = 1,
+                 pipeline: Optional[bool] = None):
         """`guard`/`watchdog` (resilience/supervisor.py) give fit() the
         same self-healing hooks as TrainingMaster: the NonFiniteGuard
         checks loss+params after (sampled) steps and skips or aborts on
@@ -114,6 +116,20 @@ class ParallelWrapper:
         self.averaging_frequency = max(1, averaging_frequency)
         self.average_updaters = average_updaters
         self.prefetch_buffer = prefetch_buffer
+        # `steps_per_dispatch=k > 1`: batches (MASKS INCLUDED — the
+        # PR 9 gap that forced fm/lm nets onto the k=1 path) group into
+        # k-windows run through the engine's lax.scan group program in
+        # ONE dispatch; byte-identical to k sequential steps.
+        self.steps_per_dispatch = max(1, int(steps_per_dispatch))
+        if self.steps_per_dispatch > 1 and self.averaging_frequency > 1:
+            raise ValueError(
+                "steps_per_dispatch > 1 and averaging_frequency > 1 "
+                "are mutually exclusive groupings (the local-SGD "
+                "rendezvous already scans its k steps in one dispatch)")
+        # harness-owned input pipeline (engine/pipeline.py): async ETL
+        # + device staging ahead of the compute. Default (None): ON for
+        # single-process jobs; pipeline=False opts out.
+        self.pipeline = pipeline
         self._sharded = False
         self._local_step = None
         # ONE supervisor (engine/): guard-verdict dispatch, watchdog
@@ -198,20 +214,57 @@ class ParallelWrapper:
             self._local_step = LocalStepTrainer(
                 net, self.mesh, average_updaters=self.average_updaters,
                 threshold=self.threshold_compression)
-        # one shared session lifecycle (engine/): watchdog start/stop,
-        # accumulator flush, and attached-iterator close on the way out
-        self._harness.attach_data(batches)
+        # harness-owned input pipeline: AsyncDataSetIterator ->
+        # DevicePrefetchIterator staging (pad + dp-shard on the way
+        # through), so data_wait/h2d overlap device_compute. The
+        # local-SGD and multi-io paths restack on host, so they take
+        # the async ETL overlap only (host_only).
+        pre_staged = False
+        if self._pipeline_enabled():
+            host_only = k > 1 or getattr(self, "_multi_io", False)
+            batches = self._harness.build_iterator_pipeline(
+                batches, depth=self.prefetch_buffer,
+                stage=None if host_only else self._stage_batch,
+                host_only=host_only,
+                meta={"mesh": dict(self.mesh.shape)})
+            pre_staged = not host_only
+        else:
+            # one shared session lifecycle (engine/): watchdog
+            # start/stop, accumulator flush, attached-iterator close
+            self._harness.attach_data(batches)
         with self._harness.session():
-            self._fit_loop(batches, epochs, k, self.watchdog)
+            self._fit_loop(batches, epochs, k, self.watchdog,
+                           pre_staged)
         return self
 
-    def _fit_loop(self, batches, epochs, k, wd):
+    def _pipeline_enabled(self) -> bool:
+        if self.pipeline is not None:
+            return bool(self.pipeline)
+        return jax.process_count() == 1
+
+    def _stage_batch(self, batch):
+        """Pipeline staging for ONE batch: pad + dp-shard exactly as
+        the synchronous loop would, so the consumer receives
+        (x, y, fm, lm) device arrays in the same layout and the
+        compiled step's byte-level evolution is unchanged."""
         net = self.net
+        x, y, fm, lm = self._pad_with_masks(*_as_batch(batch))
+        return (shard_batch(self.mesh, jnp.asarray(x, net.dtype)),
+                shard_batch(self.mesh, jnp.asarray(y, net.dtype)),
+                None if fm is None
+                else shard_batch(self.mesh, jnp.asarray(fm)),
+                None if lm is None
+                else shard_batch(self.mesh, jnp.asarray(lm)))
+
+    def _fit_loop(self, batches, epochs, k, wd, pre_staged=False):
+        net = self.net
+        k2 = self.steps_per_dispatch
         with self.mesh:
             for _ in range(epochs):
                 if hasattr(batches, "reset"):
                     batches.reset()
-                group = []
+                group = []      # local-SGD rendezvous window (host)
+                window = []     # run_group k-window (staged or host)
                 for batch in batches:
                     if wd is not None:
                         wd.beat("batch")
@@ -222,7 +275,12 @@ class ParallelWrapper:
                                 listener.iteration_done(net,
                                                         net.iteration)
                         continue
-                    x, y, fm, lm = self._pad_with_masks(*_as_batch(batch))
+                    if pre_staged:
+                        # the pipeline already padded + dp-sharded
+                        x, y, fm, lm = batch
+                    else:
+                        x, y, fm, lm = self._pad_with_masks(
+                            *_as_batch(batch))
                     if k > 1:
                         group.append((x, y, fm, lm))
                         if len(group) == k:
@@ -231,12 +289,30 @@ class ParallelWrapper:
                             self._run_guarded(
                                 lambda: self._local_step.run(g))
                         continue
-                    xb = shard_batch(self.mesh, jnp.asarray(x, net.dtype))
-                    yb = shard_batch(self.mesh, jnp.asarray(y, net.dtype))
-                    fmb = (None if fm is None
-                           else shard_batch(self.mesh, jnp.asarray(fm)))
-                    lmb = (None if lm is None
-                           else shard_batch(self.mesh, jnp.asarray(lm)))
+                    if k2 > 1:
+                        entry = (x, y, fm, lm)
+                        if window and not _window_compatible(
+                                window[-1], entry):
+                            # shape break: dispatch the shorter window
+                            # (compiled once per distinct k)
+                            self._run_window(window)
+                            window = []
+                        window.append(entry)
+                        if len(window) == k2:
+                            self._run_window(window)
+                            window = []
+                        continue
+                    if pre_staged:
+                        xb, yb, fmb, lmb = x, y, fm, lm
+                    else:
+                        xb = shard_batch(self.mesh,
+                                         jnp.asarray(x, net.dtype))
+                        yb = shard_batch(self.mesh,
+                                         jnp.asarray(y, net.dtype))
+                        fmb = (None if fm is None else
+                               shard_batch(self.mesh, jnp.asarray(fm)))
+                        lmb = (None if lm is None else
+                               shard_batch(self.mesh, jnp.asarray(lm)))
                     program = self._harness.program
                     program.require_sgd("ParallelWrapper")
 
@@ -255,7 +331,65 @@ class ParallelWrapper:
                     # local-step stack (compiled once per distinct size)
                     g = group
                     self._run_guarded(lambda: self._local_step.run(g))
+                if window:
+                    self._run_window(window)
                 net.epoch += 1
+
+    def _run_window(self, window) -> bool:
+        """One `run_group` dispatch over a k-window, MASKS STACKED
+        ALONGSIDE FEATURES — the carried-forward PR 9 gap: fm/lm
+        batches previously had no grouped path in ParallelWrapper.
+        Mask-less batches sharing a window with masked ones get
+        all-ones masks (exactly LocalStepTrainer.run's equalization),
+        and the stack is staged [k, ...] with the step dim replicated
+        and the batch dim dp-sharded. run_group(k) is byte-identical
+        to k sequential steps (pinned in test_pipeline.py for a masked
+        net)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        net = self.net
+        program = self._harness.program
+        program.require_sgd("ParallelWrapper")
+        any_fm = any(w[2] is not None for w in window)
+        any_lm = any(w[3] is not None for w in window)
+        xs, ys, fms, lms = [], [], [], []
+        for x, y, fm, lm in window:
+            x = jnp.asarray(x, net.dtype)
+            y = jnp.asarray(y, net.dtype)
+            if any_fm and fm is None:
+                fm = jnp.ones((x.shape[0],) + (() if x.ndim == 2
+                                               else (x.shape[1],)),
+                              jnp.float32)
+            if any_lm and lm is None:
+                lm = jnp.ones((x.shape[0],) if y.ndim == 2
+                              else (x.shape[0], y.shape[1]),
+                              jnp.float32)
+            xs.append(x)
+            ys.append(y)
+            if any_fm:
+                fms.append(jnp.asarray(fm))
+            if any_lm:
+                lms.append(jnp.asarray(lm))
+
+        def stack(parts):
+            # device-side stack when the pipeline pre-staged the
+            # batches (no host np.stack copy of the k-window)
+            out = jnp.stack(parts)
+            return jax.device_put(
+                out, NamedSharding(
+                    self.mesh, P(*([None, "dp"][:min(2, out.ndim)]))))
+
+        xs = stack(xs)
+        ys = stack(ys)
+        fms = stack(fms) if any_fm else None
+        lms = stack(lms) if any_lm else None
+        ok = self._run_guarded(
+            lambda: program.run_group(xs, ys, fms, lms))
+        if ok:
+            for listener in net.listeners:
+                listener.iteration_done(net, net.iteration)
+        return ok
 
     def _fit_multi_io(self, batch):
         """Multi-input/multi-output graph batch: shard every input,
@@ -292,6 +426,20 @@ class ParallelWrapper:
 def _as_batch(batch):
     from deeplearning4j_tpu.nn.multilayer import _as_batch as f
     return f(batch)
+
+
+def _window_compatible(a, b) -> bool:
+    """Two batches may share a run_group k-window when their feature/
+    label shapes match (the scan stacks them) and any masks BOTH carry
+    agree in shape (a missing mask is synthesized as ones)."""
+    for i in (0, 1):
+        if tuple(np.shape(a[i])) != tuple(np.shape(b[i])):
+            return False
+    for i in (2, 3):
+        if a[i] is not None and b[i] is not None \
+                and tuple(np.shape(a[i])) != tuple(np.shape(b[i])):
+            return False
+    return True
 
 
 def _pad_batch_with_masks(dp, x, y, fm, lm):

@@ -336,7 +336,13 @@ def test_chaos_all_training_fault_points_supervised(tmp_path):
     data.next, train.grad_nonfinite, train.hang, and train.preempt, a
     supervised TrainingMaster.fit completes, never publishes a torn or
     non-finite checkpoint, and the final params exactly match an
-    un-faulted run over the surviving (non-poisoned) data stream."""
+    un-faulted run over the surviving (non-poisoned) data stream.
+
+    pipeline=False pins the SYNCHRONOUS fetch path: this drill's
+    at_hit choreography counts fetches per processed step across
+    supervisor restarts, and a prefetching producer legitimately
+    fetches ahead of a crash (the pipelined mirror of this drill lives
+    in test_pipeline.py)."""
     net = _net()
     g = NonFiniteGuard(policy="rollback", check_every=1)
     wd = StepWatchdog(timeout_s=4.0, poll_s=0.1)
@@ -346,7 +352,7 @@ def test_chaos_all_training_fault_points_supervised(tmp_path):
     tm = TrainingMaster(net, checkpoint_dir=str(tmp_path),
                         checkpoint_every=1, guard=g, watchdog=wd,
                         preemption=True, data_retry=retry,
-                        supervisor=sup)
+                        supervisor=sup, pipeline=False)
     injector().load_spec_string(
         "train.step:raise@2,"            # worker-loss crash
         "data.next:raise@8,"             # flaky iterator (retried)

@@ -36,6 +36,23 @@ def find_rounds(directory: str):
     return [p for _, p in sorted(out)]
 
 
+def find_family(directory: str, family: str):
+    """Artifact pair/series for --metric selection. The default family
+    "r" is the flagship BENCH_r*.json round series; any other family F
+    selects BENCH_F_*.json — A/B pairs order their `_off` (baseline)
+    arm first, so `--metric pipeline` gates BENCH_pipeline_on.json
+    against BENCH_pipeline_off.json."""
+    if family == "r":
+        return find_rounds(directory)
+    paths = glob.glob(os.path.join(directory, f"BENCH_{family}_*.json"))
+
+    def key(path):
+        name = os.path.basename(path)
+        return (0 if name.endswith("_off.json") else 1, name)
+
+    return sorted(paths, key=key)
+
+
 def load_round(path: str) -> dict:
     with open(path) as f:
         doc = json.load(f)
@@ -69,8 +86,12 @@ def compare(prev: dict, new: dict, tolerance: float) -> dict:
 
 
 def _round_tag(path: str) -> str:
-    m = re.search(r"_r(\d+)\.json$", os.path.basename(path))
-    return f"r{m.group(1)}" if m else os.path.basename(path)
+    name = os.path.basename(path)
+    m = re.search(r"_r(\d+)\.json$", name)
+    if m:
+        return f"r{m.group(1)}"
+    m = re.search(r"_([a-z0-9]+)\.json$", name)
+    return m.group(1) if m else name
 
 
 def main(argv=None) -> int:
@@ -83,6 +104,12 @@ def main(argv=None) -> int:
                          "(default 0.05 = 5%%)")
     ap.add_argument("--dir", default=".",
                     help="directory holding BENCH_r*.json artifacts")
+    ap.add_argument("--metric", default="r",
+                    help="artifact family to gate: 'r' (default) = the"
+                         " BENCH_r*.json flagship rounds; any other "
+                         "name F selects BENCH_F_*.json (A/B pairs "
+                         "gate their _on arm against _off, e.g. "
+                         "--metric pipeline)")
     args = ap.parse_args(argv)
 
     if len(args.files) == 2:
@@ -91,10 +118,11 @@ def main(argv=None) -> int:
         print("PERF GATE ERROR: pass exactly two files or none")
         return 2
     else:
-        rounds = find_rounds(args.dir)
+        rounds = find_family(args.dir, args.metric)
         if len(rounds) < 2:
-            print(f"PERF GATE SKIP: fewer than two BENCH_r*.json "
-                  f"rounds in {args.dir} — nothing to compare")
+            fam = "r*" if args.metric == "r" else f"{args.metric}_*"
+            print(f"PERF GATE SKIP: fewer than two BENCH_{fam}.json "
+                  f"artifacts in {args.dir} — nothing to compare")
             return 2
         prev_path, new_path = rounds[-2], rounds[-1]
 
