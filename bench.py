@@ -371,6 +371,100 @@ def bench_pipeline(pipeline: bool, steps=48, etl_ms=12.0, batch=512,
     return steps / dt, stats["phases"], stats["pipeline"]
 
 
+def bench_mesh(n_devices=None, steps=64, batch=512, n_in=512,
+               hidden=2048, n_out=64):
+    """Sharded scale-out A/B + scaling curve (`python bench.py mesh
+    [n]` writes BENCH_mesh_{off,on}.json): the SAME dp-sharded batch
+    stream through the unsharded (replicated optimizer state)
+    StepProgram vs the ZeRO-1 mesh-sharded one (arXiv 2004.13336) on a
+    CPU device mesh, plus an img/s-vs-n_devices sweep for the zero1
+    arm — the scaling-efficiency headline shape the MULTICHIP bench
+    reruns on real hardware. The model is deliberately update-heavy
+    (fat hidden layers) because the replicated arm pays the FULL
+    weight update on every replica while zero1 pays 1/n of it; the
+    per-replica optimizer-state bytes come from real shard shapes
+    (`MeshManager.memory_facts`). Gate:
+    `python tools/perf_gate.py --metric mesh`."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu import (
+        MultiLayerNetwork,
+        NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.engine import MeshManager, StepProgram
+    from deeplearning4j_tpu.nn.conf import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    all_devs = list(jax.devices())
+    n_devices = n_devices or len(all_devs)
+
+    def build(seed=7):
+        conf = (NeuralNetConfiguration.Builder().seed(seed)
+                .updater("adam").learning_rate(1e-3).activation("tanh")
+                .weight_init("xavier").list()
+                .layer(DenseLayer(n_out=hidden))
+                .layer(DenseLayer(n_out=hidden))
+                .layer(OutputLayer(n_out=n_out, loss="mcxent"))
+                .set_input_type(InputType.feed_forward(n_in)).build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    x_host = rng.normal(size=(batch, n_in)).astype(np.float32)
+    y_host = np.eye(n_out, dtype=np.float32)[
+        rng.integers(0, n_out, batch)]
+
+    def run_arm(n, zero1):
+        net = build()
+        mgr = MeshManager(devices=all_devs[:n])
+        tree = jax.tree_util.tree_map
+        net.params = mgr.replicate_tree(tree(np.asarray, net.params))
+        stage = mgr.shard_tree if zero1 else mgr.replicate_tree
+        net.updater_states = stage(tree(np.asarray,
+                                        net.updater_states))
+        net.states = mgr.replicate_tree(tree(np.asarray, net.states))
+        prog = StepProgram(net)
+        if zero1:
+            prog.attach_mesh(mgr)
+        xb = jax.device_put(jnp.asarray(x_host), mgr.batch_sharding())
+        yb = jax.device_put(jnp.asarray(y_host), mgr.batch_sharding())
+        prog.run(xb, yb)                 # warmup/compile
+        _ = float(net._score)
+        dts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                prog.run(xb, yb)
+            _ = float(net._score)        # host fetch: true barrier
+            dts.append(time.perf_counter() - t0)
+        assert np.isfinite(float(net._score))
+        mem = mgr.memory_facts(net.updater_states)
+        return batch * steps / min(dts), mem, \
+            [d / steps * 1e3 for d in dts]
+
+    ips_off, mem_off, ms_off = run_arm(n_devices, zero1=False)
+    ips_on, mem_on, ms_on = run_arm(n_devices, zero1=True)
+    # scaling sweep (zero1): img/s and per-replica optimizer bytes
+    # per device count — the curve the 8-chip MULTICHIP bench re-runs
+    sweep = []
+    n = 1
+    while n <= n_devices:
+        ips_n, mem_n, _ = run_arm(n, zero1=True)
+        sweep.append({"n_devices": n,
+                      "images_per_sec": round(ips_n, 1),
+                      "replica_optimizer_bytes":
+                          mem_n["replica_bytes"],
+                      "scaling_efficiency": None})
+        n *= 2
+    base = sweep[0]["images_per_sec"]
+    for entry in sweep:
+        entry["scaling_efficiency"] = round(
+            entry["images_per_sec"] / (base * entry["n_devices"]), 3)
+    return {"off": (ips_off, mem_off, ms_off),
+            "on": (ips_on, mem_on, ms_on), "sweep": sweep,
+            "n_devices": n_devices}
+
+
 def bench_word2vec(vocab=5000, n_words=2_000_000, dim=128, window=5,
                    k_neg=5, epochs=5):
     """Secondary benchmark: Word2Vec skip-gram + negative sampling
@@ -513,6 +607,37 @@ def main():
                 "jax": jax.__version__,
             }
             with open(f"BENCH_pipeline_{arm}.json", "w") as f:
+                json.dump(doc, f)
+            print(json.dumps(doc))
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "mesh":
+        mn = int(sys.argv[2]) if len(sys.argv) > 2 else None
+        res = bench_mesh(n_devices=mn)
+        for arm in ("off", "on"):
+            ips, mem, ms = res[arm]
+            doc = {
+                "metric": "mesh_train_images_per_sec",
+                "value": round(ips, 1),
+                "unit": "images/sec",
+                "vs_baseline": 1.0,
+                "sharding": "zero1" if arm == "on" else "replicated",
+                "n_devices": res["n_devices"],
+                "replica_optimizer_bytes": mem["replica_bytes"],
+                "full_optimizer_bytes": mem["full_bytes"],
+                "replica_optimizer_fraction":
+                    round(mem["replica_fraction"], 4),
+                "step_ms_spread": _spread(ms),
+                "scaling_curve": (res["sweep"] if arm == "on"
+                                  else None),
+                "config": "mlp 512-2048-2048-64 batch=512 adam "
+                          "(update-heavy: replicated arm pays the "
+                          "full weight update per replica, zero1 "
+                          "pays 1/n)",
+                "device": str(dev.device_kind),
+                "platform": str(dev.platform),
+                "jax": jax.__version__,
+            }
+            with open(f"BENCH_mesh_{arm}.json", "w") as f:
                 json.dump(doc, f)
             print(json.dumps(doc))
         return

@@ -114,6 +114,11 @@ _RULE_LIST = [
          "serving pow2 bucket fill ratio below threshold — most of "
          "every dispatched batch is padding, so the MXU runs mostly "
          "dead rows"),
+    Rule("prog-unsharded-optimizer-state", "program",
+         "mesh-registered (ZeRO-1) program whose lowered module does "
+         "not shard its declared optimizer-state argument (missing "
+         "device sharding annotations or donation/aliasing) — the "
+         "state is silently replicated, n x the promised memory"),
     # ---- runtime sanitizers (DL4J_TPU_SANITIZE=locks) ----
     Rule("san-lock-order-cycle", "runtime",
          "cyclic lock-acquisition order observed across threads — a "

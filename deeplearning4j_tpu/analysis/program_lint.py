@@ -17,6 +17,10 @@ is traced/lowered here and checked against its *declared* facts:
   prog-hidden-host-transfer      outfeed/callback edges in a hot program
   prog-dead-output               computed outputs no caller consumes
   prog-excess-padding            serving pow2 bucket fill below threshold
+  prog-unsharded-optimizer-state a mesh-registered (ZeRO-1) program's
+                                 lowered module does not actually shard
+                                 its declared optimizer-state argument
+                                 (sharding annotations + alias map)
 
 Declared facts, not guesses: the intended dtype comes from the
 `precision_policy` registered on StepProgram / JitCache entries, the
@@ -52,6 +56,7 @@ REGISTERED_PROGRAM_RULES = frozenset({
     "prog-hidden-host-transfer",
     "prog-dead-output",
     "prog-excess-padding",
+    "prog-unsharded-optimizer-state",
 })
 
 # precision policies a program can declare (JitCache.policy_name)
@@ -111,6 +116,14 @@ class ProgramRecord:
     # serving bucket metadata (prog-excess-padding)
     bucket_capacity: Optional[int] = None
     bucket_rows_per_dispatch: Optional[float] = None
+    # mesh-sharded registration fact (prog-unsharded-optimizer-state):
+    # top-level example_args indices whose leaves the program DECLARES
+    # sharded (the ZeRO-1 optimizer state). The lint verifies the
+    # lowered module actually carries non-replicated mhlo.sharding
+    # annotations AND donation/aliasing on those arguments — a silent
+    # fallback to replicated state is exactly the O(n) memory
+    # regression the rule exists to catch.
+    sharded_argnums: Tuple[int, ...] = ()
 
 
 # ----------------------------------------------------------- jaxpr walk
@@ -191,11 +204,17 @@ _RESULT_RE = re.compile(r"->\s*\((.*?)\)\s*\{", re.S)
 
 
 def _main_signature(lowered_text: str) -> List[Tuple[int, str, bool]]:
-    """[(arg_index, tensor_type, has_alias)] of the lowered @main."""
+    """[(arg_index, tensor_type, has_alias)] of the lowered @main.
+    Donation shows as `tf.aliasing_output` on single-device lowerings
+    and as `jax.buffer_donor` on SPMD-partitioned ones (aliases only
+    resolve at compile there) — both count as the module carrying the
+    donation declaration."""
     m = _MAIN_SIG_RE.search(lowered_text)
     if m is None:
         return []
-    return [(int(a), t, bool(attr and "aliasing_output" in attr))
+    return [(int(a), t,
+             bool(attr and ("aliasing_output" in attr
+                            or "buffer_donor" in attr)))
             for a, t, attr in _ARG_RE.findall(m.group(1))]
 
 
@@ -292,6 +311,10 @@ def _lint_one(rec: ProgramRecord, th: Thresholds) -> List[Finding]:
             f"lowered module carries no aliasing attribute at all — "
             f"donation is silently ignored on this path")
 
+    # ---- prog-unsharded-optimizer-state ------------------------------
+    if rec.sharded_argnums:
+        _check_sharded_args(rec, lowered_text, finding)
+
     # ---- prog-fp32-matmul-under-policy -------------------------------
     if rec.precision_policy in MIXED_POLICIES:
         ops = _matmul_ops(closed)
@@ -351,6 +374,88 @@ def _lint_one(rec: ProgramRecord, th: Thresholds) -> List[Finding]:
                 f"= {churn / total:.0%} of program I/O (threshold "
                 f"{th.transpose_bytes_frac:.0%}) — layout thrash")
     return findings
+
+
+def _arg_segments(lowered_text: str) -> Dict[int, str]:
+    """{arg_index: raw attribute text} of the lowered @main signature.
+    Attribute dicts may nest braces inside quoted mhlo.sharding values
+    (`"{devices=[8]<=[8]}"`), so the signature is split on `%arg`
+    boundaries instead of brace-matched."""
+    m = _MAIN_SIG_RE.search(lowered_text)
+    if m is None:
+        return {}
+    out: Dict[int, str] = {}
+    parts = m.group(1).split("%arg")
+    for part in parts[1:]:
+        idx_end = 0
+        while idx_end < len(part) and part[idx_end].isdigit():
+            idx_end += 1
+        if idx_end == 0:
+            continue
+        out[int(part[:idx_end])] = part
+    return out
+
+
+def _check_sharded_args(rec: ProgramRecord, lowered_text: str,
+                        finding) -> None:
+    """prog-unsharded-optimizer-state: every example leaf of a
+    declared `sharded_argnums` argument that IS sharded at the call
+    site must appear in the lowered @main with a non-replicated
+    mhlo.sharding annotation AND donation/aliasing; a declaration with
+    no sharded leaf at all is the catastrophic silent-replication
+    case."""
+    import jax
+
+    segs = _arg_segments(lowered_text)
+    offsets = []
+    pos = 0
+    for a in rec.example_args:
+        n = len(jax.tree_util.tree_leaves(a))
+        offsets.append((pos, pos + n))
+        pos += n
+
+    def leaf_sharded(leaf) -> bool:
+        sh = getattr(leaf, "sharding", None)
+        return sh is not None and not sh.is_fully_replicated
+
+    for argnum in rec.sharded_argnums:
+        if argnum >= len(offsets):
+            continue
+        lo, hi = offsets[argnum]
+        leaves = jax.tree_util.tree_leaves(rec.example_args[argnum])
+        expected = [lo + i for i, leaf in enumerate(leaves)
+                    if leaf_sharded(leaf)]
+        if not expected:
+            finding(
+                "prog-unsharded-optimizer-state",
+                f"argument {argnum} is declared mesh-sharded "
+                f"optimizer state but NO leaf of it is sharded at the "
+                f"call site — the state is silently replicated (n x "
+                f"the memory the registration promises to shard)")
+            continue
+        unannotated = []
+        unaliased = []
+        for i in expected:
+            seg = segs.get(i, "")
+            if "mhlo.sharding" not in seg or "devices=" not in seg:
+                unannotated.append(i)
+            elif "buffer_donor" not in seg \
+                    and "aliasing_output" not in seg:
+                unaliased.append(i)
+        if unannotated:
+            finding(
+                "prog-unsharded-optimizer-state",
+                f"{len(unannotated)} of {len(expected)} sharded "
+                f"optimizer-state leaf/leaves of argument {argnum} "
+                f"carry no device sharding annotation in the lowered "
+                f"module — XLA receives them replicated")
+        elif unaliased:
+            finding(
+                "prog-unsharded-optimizer-state",
+                f"{len(unaliased)} of {len(expected)} sharded "
+                f"optimizer-state leaf/leaves of argument {argnum} "
+                f"are sharded but not donated/aliased — the sharded "
+                f"update still pays a full state copy per step")
 
 
 def _dead_outputs(rec: ProgramRecord, closed, out_shape,

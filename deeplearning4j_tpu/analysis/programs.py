@@ -9,6 +9,11 @@ registration) but at CPU-lintable dims:
   engine_graph                StepProgram on a ComputationGraph (the
                               flat-chain train program)
   engine_tbptt                the train_c program with donated carries
+  engine_zero1                the ZeRO-1 mesh-sharded step over the
+                              CPU device mesh, example args staged
+                              sharded — the prog-unsharded-optimizer-
+                              state record (the CLI forces 8 virtual
+                              CPU devices so the dp axis is real)
   serving_predict / buckets   ParallelInference warmup + a short driven
                               load, so bucket fill is MEASURED
   clustering_kmeans_lloyd     the donated Lloyd iteration
@@ -197,7 +202,48 @@ def build_default_records() -> List[ProgramRecord]:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     records: List[ProgramRecord] = []
     records += _engine_records()
+    records += _mesh_records()
     records += _serving_records()
     records += _clustering_records()
     records += _flagship_records()
     return records
+
+
+def _mesh_records() -> List[ProgramRecord]:
+    """The ZeRO-1 mesh-sharded StepProgram (engine/sharding.py) over
+    the CPU device mesh, with example args staged exactly as the live
+    path stages them (optimizer state SHARDED) — the record
+    `prog-unsharded-optimizer-state` verifies. Empty when the platform
+    exposes a single device (the rule is vacuous without a dp axis;
+    the CLI forces 8 virtual CPU devices)."""
+    import jax
+    import jax.numpy as jnp
+
+    if len(jax.devices()) < 2:
+        return []
+
+    from deeplearning4j_tpu import (
+        MultiLayerNetwork,
+        NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.engine import MeshManager, StepProgram
+    from deeplearning4j_tpu.nn.conf import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    n_dev = len(jax.devices())
+    conf = (NeuralNetConfiguration.Builder().seed(13).updater("adam")
+            .learning_rate(1e-3).activation("relu")
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=4 * n_dev))
+            .layer(OutputLayer(n_out=n_dev, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(2 * n_dev))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    mgr = MeshManager()
+    net.params = mgr.replicate_tree(net.params)
+    net.updater_states = mgr.shard_tree(net.updater_states)
+    net.states = mgr.replicate_tree(net.states)
+    prog = StepProgram(net).attach_mesh(mgr)
+    return [prog.lint_record_zero1(
+        jnp.zeros((2 * n_dev, 2 * n_dev), jnp.float32),
+        jnp.zeros((2 * n_dev, n_dev), jnp.float32))]

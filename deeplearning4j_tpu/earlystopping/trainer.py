@@ -18,7 +18,8 @@ logger = logging.getLogger("deeplearning4j_tpu")
 class EarlyStoppingTrainer:
     def __init__(self, config, net, train_iterator, guard=None,
                  snapshot_every: int = 0,
-                 pipeline=None, pipeline_depth: int = 2):
+                 pipeline=None, pipeline_depth: int = 2,
+                 sharding=None):
         """`guard` (resilience.NonFiniteGuard) checks the net after
         (sampled) training batches: a non-finite/spiking batch is
         skipped with the pre-batch state restored (policy='skip_step')
@@ -57,6 +58,29 @@ class EarlyStoppingTrainer:
         self._harness = StepHarness(net, guard=guard,
                                     snapshotter=self._snapshotter)
         self.guard = self._harness.guard
+        # ZeRO-1 (engine/sharding.py): _fit_batch routes through the
+        # mesh-sharded StepProgram — optimizer state sharded over the
+        # live device mesh, byte-identical to the unsharded trainer
+        if sharding not in (None, "replicated", "zero1"):
+            raise ValueError(
+                f"sharding must be None|'replicated'|'zero1': {sharding}")
+        self._mesh_mgr = None
+        if sharding == "zero1":
+            from deeplearning4j_tpu.engine.mesh import MeshManager
+
+            self._mesh_mgr = MeshManager()
+            if net.params is None:
+                net.init()
+            import jax
+            import numpy as _np
+
+            net.params = self._mesh_mgr.replicate_tree(
+                jax.tree_util.tree_map(_np.asarray, net.params))
+            net.updater_states = self._mesh_mgr.shard_tree(
+                jax.tree_util.tree_map(_np.asarray, net.updater_states))
+            net.states = self._mesh_mgr.replicate_tree(
+                jax.tree_util.tree_map(_np.asarray, net.states))
+            self._harness.program.attach_mesh(self._mesh_mgr)
 
     def _pipeline_enabled(self) -> bool:
         if self.pipeline is not None:

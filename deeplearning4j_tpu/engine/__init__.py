@@ -38,6 +38,16 @@ single step program to shard. Two halves:
                 `data_wait`/`h2d` overlap `device_compute` — built and
                 torn down by the harness session, opt-out per entry
                 point via `pipeline=False`.
+  mesh/sharding the sharded scale-out subsystem (ROADMAP item 2,
+                arXiv 2004.13336): MeshManager derives the live dp
+                mesh and owns the ZeRO-1 placement policy;
+                engine/sharding.py builds the mesh-sharded donated
+                step (reduce-scatter grads → shard-local update →
+                all-gather params inside ONE program) that
+                StepProgram.attach_mesh routes run/run_group/
+                run_batch through — `sharding="zero1"` on any entry
+                point, byte-identical to the unsharded step with 1/n
+                per-replica optimizer memory.
 """
 
 from deeplearning4j_tpu.engine.harness import StepHarness
@@ -47,6 +57,14 @@ from deeplearning4j_tpu.engine.pipeline import (
     StepPrefetcher,
     stack_staged,
 )
+from deeplearning4j_tpu.engine.mesh import MeshManager
+from deeplearning4j_tpu.engine.sharding import (
+    assemble_rows,
+    reslice,
+    slice_bounds,
+    slice_rows,
+    zero1_leaf_sharded,
+)
 from deeplearning4j_tpu.engine.step_program import (
     StepProgram,
     make_loss_and_apply,
@@ -54,4 +72,5 @@ from deeplearning4j_tpu.engine.step_program import (
 
 __all__ = ["StepProgram", "StepHarness", "make_loss_and_apply",
            "StepPrefetcher", "IteratorPipeline", "stack_staged",
-           "SKIPPED"]
+           "SKIPPED", "MeshManager", "zero1_leaf_sharded",
+           "slice_bounds", "slice_rows", "assemble_rows", "reslice"]

@@ -33,19 +33,24 @@ from __future__ import annotations
 import numpy as np
 
 
-def make_loss_and_apply(net):
+def make_loss_and_apply(net, fused: bool = True):
     """(loss_for_grad, apply_updates) closures over a net — the shared
     step math. Every compiled step variant (StepProgram single/group,
-    LocalStepTrainer's dp rendezvous, StaleGradientTrainer) builds from
-    these two closures, so a change to the step lands once.
+    the ZeRO-1 mesh-sharded step, LocalStepTrainer's dp rendezvous,
+    StaleGradientTrainer) builds from these two closures, so a change
+    to the step lands once.
 
     `loss_for_grad(params, states, x, y, rng, fm, lm)` returns
     (loss, new_states) with the net's mixed-precision policy applied
     (bf16 compute params/inputs, f32 master params and loss).
-    `apply_updates(params, upd_states, grads, lr, step)` runs the fused
+    `apply_updates(params, upd_states, grads, lr, step)` runs the
     per-layer updater chain with per-layer lr factors and frozen flags
     baked in (callers must key compiled-program caches on the frozen
-    signature)."""
+    signature). `fused=True` (default) runs the cross-layer fused
+    flat-buffer chain; `fused=False` runs the per-layer unfused path —
+    bitwise-identical math (pinned in test_mesh.py), required by the
+    ZeRO-1 sharded update whose per-leaf shardings the fused concat
+    would force XLA to all-gather."""
     import jax
 
     conf = net.conf
@@ -63,6 +68,12 @@ def make_loss_and_apply(net):
             loss = loss.astype(net.dtype)
         return loss, new_states
 
+    def _apply(items, lr, step):
+        from deeplearning4j_tpu.nn.updater import fused_apply
+        if fused:
+            return fused_apply(items, lr, step)
+        return _unfused_apply(items, lr, step)
+
     if is_graph:
         layer_names = [n.name for n in net.topo if n.kind == "layer"]
         frozen = {n.name for n in net.topo
@@ -74,8 +85,7 @@ def make_loss_and_apply(net):
             for n in net.topo if n.kind == "layer"}
 
         def apply_updates(params, upd_states, grads, lr, step):
-            from deeplearning4j_tpu.nn.updater import fused_apply
-            np_list, nu_list = fused_apply(
+            np_list, nu_list = _apply(
                 [(net._updaters[name], lr_factors[name], name in frozen,
                   params[name], grads[name], upd_states[name])
                  for name in layer_names], lr, step)
@@ -88,13 +98,32 @@ def make_loss_and_apply(net):
             else 1.0 for l in conf.layers]
 
         def apply_updates(params, upd_states, grads, lr, step):
-            from deeplearning4j_tpu.nn.updater import fused_apply
-            return fused_apply(
+            return _apply(
                 [(net._updaters[i], lr_factors[i], conf.layers[i].frozen,
                   params[i], grads[i], upd_states[i])
                  for i in range(len(params))], lr, step)
 
     return loss_for_grad, apply_updates
+
+
+def _unfused_apply(items, lr, step):
+    """Per-layer updater application — the pre-fusion formulation
+    fused_apply documents as bitwise-identical. The ZeRO-1 step uses
+    it so per-leaf GSPMD shardings survive the update (the fused
+    flat-buffer concat would all-gather the sharded state)."""
+    import jax
+
+    new_p, new_s = [], []
+    for upd, lf, frozen, p, g, s in items:
+        if frozen or not jax.tree_util.tree_leaves(p):
+            new_p.append(p)
+            new_s.append(s)
+            continue
+        deltas, ns = upd.update(g, s, p, lr * lf, step)
+        new_p.append(jax.tree_util.tree_map(
+            lambda a, d: a + d, p, deltas))
+        new_s.append(ns)
+    return new_p, new_s
 
 
 class StepProgram:
@@ -124,6 +153,83 @@ class StepProgram:
         # dispatch (device array; fetched by the guard only on checked
         # groups so the hot loop never syncs)
         self.last_step_losses = None
+        # engine/mesh.py MeshManager when the ZeRO-1 sharded path is
+        # attached: run/run_group/run_batch then route through the
+        # mesh-sharded compiled step (engine/sharding.py) instead of
+        # the net's replicated one
+        self.mesh_manager = None
+
+    # ------------------------------------------------------------ mesh
+    def attach_mesh(self, manager) -> "StepProgram":
+        """Route this program through the ZeRO-1 mesh-sharded step
+        (engine/sharding.py) over `manager`'s mesh: optimizer state
+        lives SHARDED between steps (1/n per replica), the update is
+        reduce-scatter → shard-local → all-gather inside the one
+        donated program, byte-identical to the unsharded step. Every
+        harness entry point inherits the sharded compilation through
+        run/run_group/run_batch unchanged."""
+        if self.is_tbptt:
+            raise NotImplementedError(
+                "ZeRO-1 mesh sharding does not support truncated BPTT "
+                "(per-chunk host carries); train unsharded")
+        self.mesh_manager = manager
+        return self
+
+    def _zero1_key(self, kind: str, *extra):
+        return (kind,) + tuple(extra) + (
+            self._frozen_sig(), self.mesh_manager.cache_token())
+
+    def _zero1_program(self):
+        from deeplearning4j_tpu.engine.sharding import build_zero1_step
+
+        key = self._zero1_key("engine_zero1")
+        cache = self.net._jit_cache
+        if key not in cache:
+            cache[key] = build_zero1_step(
+                self.net, self.mesh_manager, str(key))
+            cache.register_policy(key, self.precision_policy)
+        return cache[key]
+
+    def _run_zero1(self, x, y, fm=None, lm=None):
+        """One ZeRO-1 training step — the net-state contract of
+        `_train_step` (params/upd/states rebound, rng split on host,
+        iteration advanced, `_score` set) on the mesh-sharded
+        program."""
+        import jax
+        import jax.numpy as jnp
+
+        net = self.net
+        if self.is_graph:
+            x, y, fm, lm = self._graph_args(x, y, fm, lm)
+        fn = self._zero1_program()
+        net._rng, sub = jax.random.split(net._rng)
+        (net.params, net.updater_states, net.states, loss) = fn(
+            net.params, net.updater_states, net.states,
+            jnp.asarray(net.iteration, jnp.int32), x, y, fm, lm, sub,
+            jnp.asarray(net._lr_score_factor, jnp.float32))
+        net.iteration += 1
+        net._score = loss
+        net._apply_score_decay(loss)
+        return loss
+
+    # -------------------------------------- engine-owned trainer programs
+    def trainer_program(self, kind: str, build, *key_extra):
+        """Engine-owned compilation for the shard_map trainer programs
+        (LocalStepTrainer's dp rendezvous, StaleGradientTrainer's
+        delayed-gradient step): the compiled callable lives in the
+        net's JitCache under an ``(kind, *key_extra, frozen_sig)`` key
+        with the program's precision policy registered — so recompile
+        forensics, the program lint's policy checks, and the mesh arc
+        all see ONE compilation owner instead of per-trainer private
+        caches. `build(trace_key)` compiles the program; the trace key
+        is the cache key's string form (forensics names the entry the
+        same way run_group's groups are named)."""
+        cache = self.net._jit_cache
+        key = (kind,) + tuple(key_extra) + (self._frozen_sig(),)
+        if key not in cache:
+            cache[key] = build(str(key))
+            cache.register_policy(key, self.precision_policy)
+        return cache[key]
 
     # ------------------------------------------------------ validation
     def require_sgd(self, entry: str) -> None:
@@ -150,6 +256,8 @@ class StepProgram:
         to duplicate, routed into the net's cached donated step
         program. Returns the device loss scalar."""
         net = self.net
+        if self.mesh_manager is not None:
+            return self._run_zero1(x, y, fm, lm)
         chunked = self.is_tbptt and getattr(x, "ndim", 0) == 3
         if self.is_graph:
             ins, labs, fms, lms = self._graph_args(x, y, fm, lm)
@@ -165,8 +273,40 @@ class StepProgram:
     def run_batch(self, batch):
         """One step on a batch in any container shape ((x, y), DataSet,
         (x, y, fm, lm), ...) with full fit_batch semantics (listener
-        fire, solver fallback) — the EarlyStoppingTrainer entry."""
-        return self.net.fit_batch(batch)
+        fire, solver fallback) — the EarlyStoppingTrainer entry. With
+        a mesh attached the batch routes through the ZeRO-1 sharded
+        step (listener fire preserved; solvers already rejected by
+        require_sgd at the harness entry)."""
+        if self.mesh_manager is None:
+            return self.net.fit_batch(batch)
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nn.multilayer import (
+            _as_batch as _as_b,
+        )
+
+        import jax
+
+        net = self.net
+        mgr = self.mesh_manager
+        x, y, fm, lm = _as_b(batch)
+        # dp-shard the batch when divisible (the same staging
+        # TrainingMaster / ParallelWrapper feed run() with — and the
+        # layout the byte-parity oracle stages); an indivisible batch
+        # replicates, trading partitioned compute for correctness
+        b = int(np.asarray(x).shape[0])
+        sh = (mgr.batch_sharding() if b % mgr.dp == 0
+              else mgr.replicated())
+        put = lambda a: jax.device_put(jnp.asarray(a, net.dtype), sh)
+        x = put(x)
+        y = put(y)
+        net._last_batch_size = b
+        fm = None if fm is None else put(fm)
+        lm = None if lm is None else put(lm)
+        loss = self._run_zero1(x, y, fm, lm)
+        for listener in net.listeners:
+            listener.iteration_done(net, net.iteration)
+        return loss
 
     # ------------------------------------------------------ k-step group
     def _frozen_sig(self):
@@ -246,12 +386,25 @@ class StepProgram:
         k = int(np.asarray(xs).shape[0])
         if self.is_graph:
             xs, ys, fms, lms = self._graph_args(xs, ys, fms, lms)
-        key = self.group_key(k, fms is not None, lms is not None)
-        cache = net._jit_cache
-        if key not in cache:
-            cache[key] = self._build_group(
-                k, fms is not None, lms is not None, str(key))
-            cache.register_policy(key, self.precision_policy)
+        if self.mesh_manager is not None:
+            from deeplearning4j_tpu.engine.sharding import (
+                build_zero1_group,
+            )
+
+            key = self._zero1_key("engine_zero1_group", k,
+                                  fms is not None, lms is not None)
+            cache = net._jit_cache
+            if key not in cache:
+                cache[key] = build_zero1_group(
+                    net, self.mesh_manager, k, str(key))
+                cache.register_policy(key, self.precision_policy)
+        else:
+            key = self.group_key(k, fms is not None, lms is not None)
+            cache = net._jit_cache
+            if key not in cache:
+                cache[key] = self._build_group(
+                    k, fms is not None, lms is not None, str(key))
+                cache.register_policy(key, self.precision_policy)
         (net.params, net.updater_states, net.states, net._rng,
          losses) = cache[key](
             net.params, net.updater_states, net.states, net._rng,
@@ -321,6 +474,52 @@ class StepProgram:
                 precision_policy=self.precision_policy, source=source,
                 consumed_outputs=tuple(range(5))))
         return records
+
+    def lint_record_zero1(self, x, y, name=None):
+        """ProgramRecord of the ZeRO-1 mesh-sharded step for
+        `analysis/program_lint` (requires an attached mesh). The
+        example args are staged exactly as the live path stages them —
+        params replicated, optimizer state SHARDED, batch dp-sharded —
+        so the lowering bakes the real sharding annotations the
+        `prog-unsharded-optimizer-state` rule verifies, and
+        `sharded_argnums` declares which argument's leaves must carry
+        them (argnum 1 = the optimizer state)."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.analysis.program_lint import (
+            ProgramRecord,
+        )
+
+        if self.mesh_manager is None:
+            raise ValueError("lint_record_zero1 requires attach_mesh")
+        net = self.net
+        mgr = self.mesh_manager
+        if net.params is None:
+            net.init()
+        fn = self._zero1_program()
+        params = mgr.replicate_tree(jax.tree_util.tree_map(
+            np.asarray, net.params))
+        upd = mgr.shard_tree(jax.tree_util.tree_map(
+            np.asarray, net.updater_states))
+        states = mgr.replicate_tree(jax.tree_util.tree_map(
+            np.asarray, net.states))
+        xb = jax.device_put(jnp.asarray(x, net.dtype),
+                            mgr.batch_sharding())
+        yb = jax.device_put(jnp.asarray(y, net.dtype),
+                            mgr.batch_sharding())
+        _, sub = jax.random.split(net._rng)
+        args = (params, upd, states,
+                jnp.asarray(net.iteration, jnp.int32), xb, yb, None,
+                None, sub,
+                jnp.asarray(net._lr_score_factor, jnp.float32))
+        return ProgramRecord(
+            name=name or "engine_zero1",
+            fn=getattr(fn, "__wrapped__", fn), example_args=args,
+            precision_policy=self.precision_policy,
+            source="deeplearning4j_tpu/engine/sharding.py",
+            consumed_outputs=tuple(range(4)),
+            sharded_argnums=(1,))
 
     # ------------------------------------------------------------- perf
     def register_perf(self, cost_model, key=None, *example_args,

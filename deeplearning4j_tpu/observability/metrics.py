@@ -102,6 +102,10 @@ REGISTERED_METRICS = frozenset({
     "dl4j_pipeline_wait_seconds",
     "dl4j_pipeline_reseeks_total",
     "dl4j_pipeline_depth",
+    # device-mesh sharding subsystem (engine/mesh.py, ZeRO-1 scale-out)
+    "dl4j_mesh_world_size",
+    "dl4j_mesh_reshard_total",
+    "dl4j_mesh_allgather_seconds",
     # resilience plumbing
     "dl4j_retry_attempts_total",
     "dl4j_breaker_transitions_total",

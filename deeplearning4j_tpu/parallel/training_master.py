@@ -86,7 +86,8 @@ class TrainingMaster:
                  steps_per_dispatch: int = 1,
                  per_rank_checkpoints: bool = False,
                  pipeline: Optional[bool] = None,
-                 pipeline_depth: int = 2):
+                 pipeline_depth: int = 2,
+                 sharding: Optional[str] = None):
         """`averaging_frequency=k > 1` runs k-step local SGD between
         parameter rendezvous — each dp shard trains privately for k
         steps, then params (+ updater state) are averaged. This is the
@@ -105,6 +106,15 @@ class TrainingMaster:
         if checkpoint_format not in ("npz", "orbax"):
             raise ValueError(
                 f"checkpoint_format must be npz|orbax: {checkpoint_format}")
+        if sharding not in (None, "replicated", "zero1"):
+            raise ValueError(
+                f"sharding must be None|'replicated'|'zero1': {sharding}")
+        # ZeRO-1 (engine/sharding.py, arXiv 2004.13336): optimizer
+        # state sharded over the mesh's dp axis, the weight update
+        # reduce-scattered / shard-local / all-gathered INSIDE the one
+        # compiled step. Byte-identical to the replicated program;
+        # 1/n per-replica optimizer memory.
+        self.zero1 = sharding == "zero1"
         self.net = net
         # per-rank checkpoint copies (`<dir>/rank-<r>/`): EVERY process
         # writes its own copy instead of process 0 alone — the input
@@ -118,6 +128,7 @@ class TrainingMaster:
             raise ValueError(
                 "per_rank_checkpoints requires checkpoint_format='npz' "
                 "(the divergence quorum votes over npz state digests)")
+        self._ckpt_base = checkpoint_dir
         if self.per_rank_checkpoints and checkpoint_dir:
             from deeplearning4j_tpu.resilience.checkpoint_integrity import (
                 rank_checkpoint_dir,
@@ -206,6 +217,28 @@ class TrainingMaster:
         self._obs_acc = self._harness.acc
         self._poisoned_steps = self._harness.poisoned_steps
         self._resil_counters = self._harness.counters
+        self._mesh_mgr = None
+        if self.zero1:
+            if self.averaging_frequency > 1:
+                raise ValueError(
+                    "sharding='zero1' and averaging_frequency > 1 are "
+                    "incompatible (local SGD keeps per-shard params; "
+                    "ZeRO-1 shards the synchronous update)")
+            if checkpoint_format != "npz":
+                raise ValueError(
+                    "sharding='zero1' requires checkpoint_format='npz'"
+                    " (sharded optimizer-state slices ride npz "
+                    "sidecars)")
+            if (self.checkpoint_dir and jax.process_count() > 1
+                    and not self.per_rank_checkpoints):
+                raise ValueError(
+                    "sharding='zero1' in a multi-process gang needs "
+                    "per_rank_checkpoints=True (every rank must write "
+                    "its own optimizer-state slice)")
+            from deeplearning4j_tpu.engine.mesh import MeshManager
+
+            self._mesh_mgr = MeshManager(mesh=self.mesh)
+            self._harness.program.attach_mesh(self._mesh_mgr)
 
     # tracer / phase_profiler delegate to the harness so post-
     # construction assignment (bench_obs.py's config sweep) reaches
@@ -276,6 +309,7 @@ class TrainingMaster:
         return {"processes": int(jax.process_count()),
                 "devices": len(jax.devices()),
                 "dp": dp,
+                "sharding": "zero1" if self.zero1 else "replicated",
                 "per_rank_checkpoints": self.per_rank_checkpoints}
 
     # ------------------------------------------------------------- staging
@@ -299,7 +333,18 @@ class TrainingMaster:
             self.net.init()
         _disable_flat_chain(self.net)
         self.net.params = self._replicated(self.net.params)
-        self.net.updater_states = self._replicated(self.net.updater_states)
+        if self._mesh_mgr is not None:
+            # ZeRO-1: optimizer state lives SHARDED between steps —
+            # divisible leaves split their leading dim over dp (1/n
+            # per replica), the rest replicate
+            import jax as _jax
+
+            self.net.updater_states = self._mesh_mgr.shard_tree(
+                _jax.tree_util.tree_map(self._host_leaf,
+                                        self.net.updater_states))
+        else:
+            self.net.updater_states = self._replicated(
+                self.net.updater_states)
         self.net.states = self._replicated(self.net.states)
         self._staged = True
 
@@ -506,15 +551,16 @@ class TrainingMaster:
 
     # --------------------------------------------------- input pipeline
     def _pipeline_enabled(self) -> bool:
-        """Pipeline resolution: explicit flag wins; default ON for
-        single-process jobs, OFF multi-host (every rank's staging must
-        stay in the consumer's program order until the sharded
-        scale-out arc makes cross-rank staging explicit)."""
+        """Pipeline resolution: explicit flag wins; default ON
+        everywhere. Multi-host staging is sharding-aware (the producer
+        thread stages THIS rank's partition through
+        `make_array_from_process_local_data` on the live mesh — a
+        per-process placement, no cross-rank coordination to
+        misorder), so the PR 12 multi-host auto-off is gone;
+        pipeline=False opts out."""
         if self.pipeline is not None:
             return bool(self.pipeline)
-        import jax
-
-        return jax.process_count() == 1
+        return True
 
     def _produce(self, batch_fn, step):
         """Producer-side work for ONE step (runs on the prefetch
@@ -1146,10 +1192,20 @@ class TrainingMaster:
         net = self.net
         payload = {}
         for group, tree in (("params", net.params),
-                            ("upd", net.updater_states),
                             ("states", net.states)):
             for i, leaf in enumerate(jax.tree_util.tree_leaves(tree)):
                 payload[f"{group}:{i}"] = self._host_leaf(leaf)
+        # ZeRO-1: sharded optimizer leaves go to a per-rank sidecar
+        # (this rank's slice only); the replicated remainder rides the
+        # main payload so the divergence quorum's state digest stays
+        # identical across ranks
+        shard_slices = None
+        if self._mesh_mgr is not None:
+            shard_slices = self._sharded_upd_payload(payload)
+        else:
+            for i, leaf in enumerate(
+                    jax.tree_util.tree_leaves(net.updater_states)):
+                payload[f"upd:{i}"] = self._host_leaf(leaf)
         payload["rng"] = np.asarray(net._rng)
         # self-describing: fallback loads recover position without
         # trusting latest.json (which may point at the damaged step)
@@ -1185,12 +1241,136 @@ class TrainingMaster:
                                 extra={"step": step,
                                        "state_sha256": state_sha})
 
+        if shard_slices is not None:
+            # sidecar FIRST: a published main step implies its slice
+            # exists (a kill between the two leaves an orphan sidecar,
+            # which the sharded quorum simply never elects)
+            self._write_shard_sidecar(step, shard_slices, state_sha)
         self._ckpt_retry.call(_write)
         meta = {"step": step, "iteration": int(net.iteration),
                 "epoch": int(net.epoch)}
         _ci.atomic_write_json(
             os.path.join(self.checkpoint_dir, "latest.json"), meta)
         _ci.apply_retention(self.checkpoint_dir, self.keep_last)
+
+    def _sharded_upd_payload(self, payload) -> dict:
+        """Split the updater-state leaves for the ZeRO-1 checkpoint
+        layout: replicated leaves into the (quorum-voted) main
+        `payload` as `upd:<i>`, sharded leaves gathered from the mesh
+        (timed as `dl4j_mesh_allgather_seconds`) and sliced to THIS
+        process's contiguous rows for the sidecar. The main payload
+        records `upd_sharded_idx` + `shard_world` so the digest covers
+        the layout itself."""
+        import jax
+
+        from deeplearning4j_tpu.engine.sharding import slice_rows
+
+        net = self.net
+        mgr = self._mesh_mgr
+        layout = mgr.shard_layout(net.updater_states)
+        full = mgr.gather_tree(net.updater_states)
+        leaves = jax.tree_util.tree_leaves(full)
+        world = max(1, int(jax.process_count()))
+        rank = int(jax.process_index())
+        slices = {}
+        sharded_idx = []
+        for i, (leaf, sharded) in enumerate(zip(leaves, layout)):
+            if sharded and leaf.shape[0] % world == 0:
+                sharded_idx.append(i)
+                slices[f"slice:{i}"] = slice_rows(leaf, rank, world)
+            else:
+                payload[f"upd:{i}"] = leaf
+        payload["upd_sharded_idx"] = np.asarray(sharded_idx, np.int64)
+        payload["shard_world"] = np.asarray(world)
+        return slices
+
+    def _write_shard_sidecar(self, step, slices, state_sha):
+        """This rank's optimizer-state slice sidecar: atomic write +
+        manifest entry carrying `main_state_sha256`, the digest of the
+        main state the slice belongs to — the linkage the sharded
+        quorum verifies before trusting a slice."""
+        import jax
+
+        side_fn = _ci.shard_sidecar_filename(step)
+        side = os.path.join(self.checkpoint_dir, side_fn)
+        world = max(1, int(jax.process_count()))
+        rank = int(jax.process_index())
+
+        def _write_side():
+            with _ci.atomic_writer(side, suffix=".tmp.npz") as tmp:
+                with open(tmp, "wb") as f:
+                    np.savez(f, shard_rank=np.asarray(rank),
+                             shard_world=np.asarray(world), **slices)
+                digest = _ci.sha256_file(tmp)
+                size = os.path.getsize(tmp)
+            _ci.record_checksum(
+                self.checkpoint_dir, side_fn, digest, size,
+                extra={"step": step, "shard_rank": rank,
+                       "shard_world": world,
+                       "main_state_sha256": state_sha})
+
+        self._ckpt_retry.call(_write_side)
+
+    def _restore_sharded_upd(self, data, step: int):
+        """Host updater-state tree reassembled from the sharded
+        checkpoint layout: replicated leaves from the main payload,
+        sharded leaves from the per-rank sidecar slices — saved at ANY
+        world size; the zero1 staging re-slices for the CURRENT world
+        (resharding on resume, counted as `dl4j_mesh_reshard_total`
+        when the worlds differ)."""
+        import jax
+
+        from deeplearning4j_tpu.engine.sharding import assemble_rows
+        from deeplearning4j_tpu.resilience.errors import (
+            CheckpointIntegrityError,
+        )
+
+        net = self.net
+        leaves, treedef = jax.tree_util.tree_flatten(net.updater_states)
+        world = int(data["shard_world"])
+        sharded_idx = [int(i) for i in
+                       np.asarray(data["upd_sharded_idx"]).reshape(-1)]
+        new = [None] * len(leaves)
+        for i in range(len(leaves)):
+            if i not in sharded_idx:
+                new[i] = data[f"upd:{i}"]
+        if sharded_idx:
+            fn = os.path.basename(self._ckpt_path(step))
+            expect = _ci.state_digest(self.checkpoint_dir, fn)
+            if self.per_rank_checkpoints or world > 1:
+                base = self._ckpt_base
+                dirs = [_ci.rank_checkpoint_dir(base, r)
+                        for r in range(world)]
+            else:
+                dirs = [self.checkpoint_dir]
+            slices = _ci.collect_sharded_slices(
+                dirs, step, expect_digest=expect)
+            if slices is None:
+                raise CheckpointIntegrityError(
+                    f"sharded checkpoint step {step}: optimizer-state "
+                    f"slice set incomplete or untrusted across "
+                    f"{len(dirs)} rank dir(s)")
+            opened = {r: np.load(p) for r, p in slices.items()}
+            try:
+                for i in sharded_idx:
+                    new[i] = assemble_rows(
+                        {r: d[f"slice:{i}"] for r, d in opened.items()},
+                        world)
+            finally:
+                for d in opened.values():
+                    d.close()
+        cur_world = self.world_info()["processes"]
+        if world != cur_world:
+            # loading slices written by a different world: the staging
+            # below re-slices them for the live mesh
+            if self._mesh_mgr is not None:
+                self._mesh_mgr.reshards += 1
+            _obs.count("dl4j_mesh_reshard_total")
+            logger.warning(
+                "sharded checkpoint step %d: resharding optimizer "
+                "state from save-world %d to live world %d", step,
+                world, cur_world)
+        return jax.tree_util.tree_unflatten(treedef, new)
 
     def _orbax_path(self, step: int) -> str:
         return os.path.abspath(os.path.join(
@@ -1363,8 +1543,16 @@ class TrainingMaster:
             return jax.tree_util.tree_unflatten(treedef, new)
 
         net.params = self._replicated(restore("params", net.params))
-        net.updater_states = self._replicated(
-            restore("upd", net.updater_states))
+        if "shard_world" in data.files:
+            upd = self._restore_sharded_upd(data, step)
+        else:
+            upd = restore("upd", net.updater_states)
+        if self._mesh_mgr is not None:
+            # zero1 staging re-slices the assembled state for the LIVE
+            # mesh — the resharding-on-resume placement
+            net.updater_states = self._mesh_mgr.shard_tree(upd)
+        else:
+            net.updater_states = self._replicated(upd)
         net.states = self._replicated(restore("states", net.states))
         net._rng = jax.numpy.asarray(data["rng"])
         # newer checkpoints are self-describing; latest.json only covers

@@ -78,7 +78,8 @@ class ParallelWrapper:
                  guard=None, watchdog=None, snapshot_every: int = 0,
                  phase_profiler=None,
                  steps_per_dispatch: int = 1,
-                 pipeline: Optional[bool] = None):
+                 pipeline: Optional[bool] = None,
+                 sharding: Optional[str] = None):
         """`guard`/`watchdog` (resilience/supervisor.py) give fit() the
         same self-healing hooks as TrainingMaster: the NonFiniteGuard
         checks loss+params after (sampled) steps and skips or aborts on
@@ -145,6 +146,28 @@ class ParallelWrapper:
         self.watchdog = self._harness.watchdog
         self._obs_acc = self._harness.acc
         self.phase_profiler = self._harness.phase_profiler
+        # ZeRO-1 (engine/sharding.py): optimizer state sharded over
+        # this wrapper's dp axis, update reduce-scattered/shard-local/
+        # all-gathered inside the one compiled step — byte-identical
+        # to the replicated program (pinned in test_mesh.py)
+        if sharding not in (None, "replicated", "zero1"):
+            raise ValueError(
+                f"sharding must be None|'replicated'|'zero1': {sharding}")
+        self.zero1 = sharding == "zero1"
+        self._mesh_mgr = None
+        if self.zero1:
+            if self.mesh.shape["tp"] != 1:
+                raise NotImplementedError(
+                    "sharding='zero1' requires tp == 1 (the ZeRO "
+                    "update shards the dp axis of replicated params)")
+            if self.averaging_frequency > 1:
+                raise ValueError(
+                    "sharding='zero1' and averaging_frequency > 1 are "
+                    "incompatible (local SGD keeps per-shard params)")
+            from deeplearning4j_tpu.engine.mesh import MeshManager
+
+            self._mesh_mgr = MeshManager(mesh=self.mesh)
+            self._harness.program.attach_mesh(self._mesh_mgr)
 
     # ------------------------------------------------------------------
     def _ensure_sharded(self):
@@ -166,7 +189,14 @@ class ParallelWrapper:
             lambda x, s: jax.device_put(x, s),
             tree, param_shardings(self.mesh, tree))
         self.net.params = put(self.net.params)
-        self.net.updater_states = put(self.net.updater_states)
+        if self._mesh_mgr is not None:
+            # ZeRO-1: optimizer state placed SHARDED over dp (1/n per
+            # replica) instead of replicated
+            self.net.updater_states = self._mesh_mgr.shard_tree(
+                jax.tree_util.tree_map(np.asarray,
+                                       self.net.updater_states))
+        else:
+            self.net.updater_states = put(self.net.updater_states)
         self.net.states = jax.tree_util.tree_map(
             lambda x: jax.device_put(x, NamedSharding(self.mesh, P())),
             self.net.states)
@@ -221,7 +251,14 @@ class ParallelWrapper:
         # the async ETL overlap only (host_only).
         pre_staged = False
         if self._pipeline_enabled():
-            host_only = k > 1 or getattr(self, "_multi_io", False)
+            # zero1 stages on the consumer thread (host_only): staging
+            # batch k+1 while a donated SHARDED-state execution is in
+            # flight corrupts the heap in this jaxlib's CPU runtime
+            # (reproducibly, only with a warm persistent compile
+            # cache); the async-ETL overlap is kept, the device copy
+            # moves next to the dispatch
+            host_only = (k > 1 or getattr(self, "_multi_io", False)
+                         or self.zero1)
             batches = self._harness.build_iterator_pipeline(
                 batches, depth=self.prefetch_buffer,
                 stage=None if host_only else self._stage_batch,
@@ -494,7 +531,8 @@ class LocalStepTrainer:
     """
 
     def __init__(self, net, mesh: Mesh, average_updaters: bool = True,
-                 threshold: float = 0.0, per_step_losses: bool = False):
+                 threshold: float = 0.0, per_step_losses: bool = False,
+                 program=None):
         """`threshold > 0` enables threshold compression of the k-step
         parameter delta at each rendezvous (the reference's
         EncodingHandler.java:57-73 role, composed with local SGD): each
@@ -526,14 +564,21 @@ class LocalStepTrainer:
         # step; off by default — the compiled program is unchanged
         self.per_step_losses = bool(per_step_losses)
         self.last_step_losses = None
-        self._fn_cache = {}
+        # compilation is ENGINE-owned (PR 9 follow-on): the shard_map
+        # programs live in the net's JitCache through
+        # StepProgram.trainer_program — recompile forensics, precision
+        # policy registration, and the mesh arc see one owner
+        from deeplearning4j_tpu.engine import StepProgram
+
+        self._program = program or StepProgram(net)
         self._residual = None
         self._sent_nnz = []          # per-rendezvous device scalars
         self._param_entries = None
         self._n_rendezvous = 0
 
     # -------------------------------------------------------------- build
-    def _build(self, k: int, with_fm: bool, with_lm: bool):
+    def _build(self, k: int, with_fm: bool, with_lm: bool,
+               trace_key: str = "local_sgd"):
         from deeplearning4j_tpu.nn.updater import schedule_lr
 
         net = self.net
@@ -545,6 +590,7 @@ class LocalStepTrainer:
 
         def worker(params, upd_states, states, residual, step0, xs, ys,
                    fms, lms, rng, lr_scale):
+            net._jit_cache.record_trace(trace_key)
             # decorrelate dropout across shards
             rng = jax.random.fold_in(rng, jax.lax.axis_index("dp"))
             keys = jax.random.split(rng, k)
@@ -748,25 +794,21 @@ class LocalStepTrainer:
             lead = (next(iter(xs_in.values())) if is_graph else xs_in)
             k = int(lead.shape[0])
 
-        # frozen flags are baked into the trace (same contract as the
-        # containers' per-step cache): key on them so freeze/unfreeze
-        # between fits takes effect
-        if is_graph:
-            frozen_sig = tuple(sorted(
-                n.name for n in net.topo
-                if n.kind == "layer" and n.obj.frozen))
-        else:
-            frozen_sig = tuple(i for i, l in enumerate(net.conf.layers)
-                               if l.frozen)
-        key = (k, fms_in is not None, lms_in is not None, is_graph,
-               frozen_sig)
-        if key not in self._fn_cache:
-            self._fn_cache[key] = self._build(
-                k, fms_in is not None, lms_in is not None)
+        # engine-owned compilation: the JitCache key carries the
+        # frozen signature (freeze/unfreeze between fits takes effect)
+        # and the program registers its precision policy + forensics
+        # trace like every other engine program
+        with_fm = fms_in is not None
+        with_lm = lms_in is not None
+        fn = self._program.trainer_program(
+            "engine_local_sgd",
+            lambda tk: self._build(k, with_fm, with_lm, tk),
+            k, with_fm, with_lm, self.per_step_losses,
+            self.threshold > 0.0)
         net._rng, sub = jax.random.split(net._rng)
         if self._residual is None:
             self._residual = self._init_residual()
-        out = self._fn_cache[key](
+        out = fn(
                 net.params, net.updater_states, net.states,
                 self._residual,
                 jnp.asarray(net.iteration, jnp.int32),
@@ -814,19 +856,25 @@ class StaleGradientTrainer:
     truncated BPTT.
     """
 
-    def __init__(self, net, mesh: Mesh):
+    def __init__(self, net, mesh: Mesh, program=None):
         if mesh.shape["tp"] != 1:
             raise NotImplementedError(
                 "StaleGradientTrainer requires tp == 1")
         if getattr(net.conf, "backprop_type", None) == "truncated_bptt":
             raise NotImplementedError(
                 "StaleGradientTrainer does not support truncated BPTT")
+        from deeplearning4j_tpu.engine import StepProgram
+
         self.net = net
         self.mesh = mesh
-        self._fn_cache = {}
+        # compilation is engine-owned (StepProgram.trainer_program):
+        # the delayed-gradient programs live in the net's JitCache
+        # with forensics + precision-policy registration
+        self._program = program or StepProgram(net)
         self._pending = None     # g_{t-1}: replicated averaged gradient
 
-    def _build(self, with_fm: bool, with_lm: bool, flush: bool):
+    def _build(self, with_fm: bool, with_lm: bool, flush: bool,
+               trace_key: str = "stale_grad"):
         from deeplearning4j_tpu.nn.updater import schedule_lr
 
         net = self.net
@@ -837,6 +885,7 @@ class StaleGradientTrainer:
 
         def worker(params, upd_states, states, prev_g, step, x, y, fm,
                    lm, rng, lr_scale):
+            net._jit_cache.record_trace(trace_key)
             lr = schedule_lr(conf, step) * lr_scale
             if flush:
                 # terminal half-step: apply the last pending gradient
@@ -876,25 +925,18 @@ class StaleGradientTrainer:
     def _zero_grads(self):
         return jax.tree_util.tree_map(jnp.zeros_like, self.net.params)
 
-    def _frozen_sig(self):
-        net = self.net
-        if hasattr(net.conf, "network_inputs"):
-            return tuple(sorted(n.name for n in net.topo
-                                if n.kind == "layer" and n.obj.frozen))
-        return tuple(i for i, l in enumerate(net.conf.layers)
-                     if l.frozen)
-
     def step(self, x, y, fm=None, lm=None):
         net = self.net
         if self._pending is None:
             self._pending = self._zero_grads()
-        key = (fm is not None, lm is not None, False,
-               self._frozen_sig())
-        if key not in self._fn_cache:
-            self._fn_cache[key] = self._build(key[0], key[1], False)
+        with_fm, with_lm = fm is not None, lm is not None
+        fn = self._program.trainer_program(
+            "engine_stale",
+            lambda tk: self._build(with_fm, with_lm, False, tk),
+            with_fm, with_lm)
         net._rng, sub = jax.random.split(net._rng)
         (net.params, net.updater_states, net.states, self._pending,
-         loss) = self._fn_cache[key](
+         loss) = fn(
             net.params, net.updater_states, net.states, self._pending,
             jnp.asarray(net.iteration, jnp.int32), x, y, fm, lm, sub,
             jnp.asarray(net._lr_score_factor, jnp.float32))
@@ -910,12 +952,12 @@ class StaleGradientTrainer:
         net = self.net
         if self._pending is None:
             return
-        key = (False, False, True, self._frozen_sig())
-        if key not in self._fn_cache:
-            self._fn_cache[key] = self._build(False, False, True)
+        fn = self._program.trainer_program(
+            "engine_stale_flush",
+            lambda tk: self._build(False, False, True, tk))
         dummy = jnp.zeros((self.mesh.shape["dp"], 1), net.dtype)
         (net.params, net.updater_states, net.states, self._pending,
-         _) = self._fn_cache[key](
+         _) = fn(
             net.params, net.updater_states, net.states, self._pending,
             jnp.asarray(net.iteration, jnp.int32), dummy, dummy, None,
             None, jax.random.PRNGKey(0),

@@ -50,9 +50,12 @@ from deeplearning4j_tpu.resilience.checkpoint_integrity import (
     divergence_quorum,
     list_all_checkpoints,
     newest_valid_checkpoint,
+    collect_sharded_slices,
     quorum_resume_step,
     rank_checkpoint_dir,
     record_checksum,
+    shard_sidecar_filename,
+    sharded_quorum_resume_step,
     require_valid,
     require_valid_tree,
     sha256_file,
@@ -97,6 +100,8 @@ __all__ = [
     "apply_retention", "atomic_write_bytes", "atomic_write_json",
     "atomic_writer", "compute_state_digest", "divergence_quorum",
     "list_all_checkpoints", "newest_valid_checkpoint",
+    "collect_sharded_slices", "shard_sidecar_filename",
+    "sharded_quorum_resume_step",
     "quorum_resume_step", "rank_checkpoint_dir", "record_checksum",
     "require_valid", "require_valid_tree", "sha256_file",
     "state_digest", "validate_file", "validate_tree",

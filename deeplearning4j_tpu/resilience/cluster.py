@@ -343,7 +343,8 @@ class ClusterSupervisor:
                  spares: int = 0,
                  allow_shrink: bool = False,
                  min_workers: int = 1,
-                 per_rank_checkpoints: bool = False):
+                 per_rank_checkpoints: bool = False,
+                 sharded_optimizer: bool = False):
         """Elastic knobs: `spares=N` holds N standby slots a
         quarantined rank reschedules onto (fresh workdir, same rank,
         budget reset); `allow_shrink=True` lets the gang relaunch at
@@ -351,7 +352,13 @@ class ClusterSupervisor:
         run out; `per_rank_checkpoints=True` switches the resume
         handshake to the checkpoint_integrity divergence quorum over
         `<checkpoint_dir>/rank-<r>/` directories (minority forks are
-        quarantined aside and healed before any rank resumes)."""
+        quarantined aside and healed before any rank resumes).
+        `sharded_optimizer=True` (ZeRO-1 workers) upgrades that quorum
+        to the sharded variant: the vote runs over the SAVE-time world
+        read from the copies themselves — after a shrink, retired
+        ranks' dirs still vote and still contribute their optimizer
+        slice — and a step only wins when its slice set is complete
+        and tied to the elected digest."""
         self.nprocs = int(nprocs)
         self.command_fn = command_fn
         self.heartbeat_dir = heartbeat_dir
@@ -371,6 +378,7 @@ class ClusterSupervisor:
         self.allow_shrink = bool(allow_shrink)
         self.min_workers = max(1, int(min_workers))
         self.per_rank_checkpoints = bool(per_rank_checkpoints)
+        self.sharded_optimizer = bool(sharded_optimizer)
         os.makedirs(self.heartbeat_dir, exist_ok=True)
         os.makedirs(self.log_dir, exist_ok=True)
         self.members = [
@@ -561,8 +569,15 @@ class ClusterSupervisor:
         if not self.checkpoint_dir:
             return 0
         if self.per_rank_checkpoints:
-            report = _ci.quorum_resume_step(self.checkpoint_dir,
-                                            self.nprocs)
+            if self.sharded_optimizer:
+                # ZeRO-1 checkpoints: quorum over the save-time world
+                # (retired ranks still vote and contribute slices),
+                # slice-set completeness gates the election
+                report = _ci.sharded_quorum_resume_step(
+                    self.checkpoint_dir, self.nprocs)
+            else:
+                report = _ci.quorum_resume_step(self.checkpoint_dir,
+                                                self.nprocs)
             if report is None:
                 return 0
             self.quorum_reports.append(report)

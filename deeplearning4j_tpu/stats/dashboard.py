@@ -372,6 +372,19 @@ def telemetry_lines(snapshot) -> list:
             "restarts · "
             f"{c.get('dl4j_cluster_quarantined_workers_total', 0)} "
             "quarantined workers")
+    # device-mesh sharding (engine/mesh.py): live world, reshard count,
+    # checkpoint all-gather cost — the ZeRO-1 scale-out status line
+    mesh_world = gauge("dl4j_mesh_world_size")
+    if mesh_world is not None or "dl4j_mesh_reshard_total" in c:
+        mesh = []
+        if mesh_world is not None:
+            mesh.append(f"world {int(mesh_world)}")
+        mesh.append(f"{c.get('dl4j_mesh_reshard_total', 0)} reshards")
+        ag = hists.get("dl4j_mesh_allgather_seconds")
+        if ag and ag.get("count"):
+            mesh.append(
+                f"allgather {ag['sum'] / ag['count'] * 1e3:.1f}ms avg")
+        lines.append("mesh — " + " · ".join(mesh))
     if "dl4j_serving_requests_total" in c:
         serv = [f"{c['dl4j_serving_requests_total']} requests "
                 f"({c.get('dl4j_serving_errors_total', 0)} errors)"]

@@ -77,4 +77,25 @@ def build_records():
     records.append(ProgramRecord(
         name="bad_excess_padding", bucket_capacity=32,
         bucket_rows_per_dispatch=3.0, source=SRC))
+
+    # prog-unsharded-optimizer-state: the registration declares the
+    # optimizer-state argument mesh-sharded (ZeRO-1), but the call
+    # site stages it REPLICATED — the silent n-x memory regression
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    rep = NamedSharding(mesh, P())
+
+    def unsharded_opt(p, m, x):
+        g = jnp.mean(x) * jnp.ones_like(p)
+        m2 = 0.9 * m + g
+        return p - 0.1 * m2, m2
+
+    records.append(ProgramRecord(
+        name="bad_unsharded_optimizer", fn=unsharded_opt,
+        example_args=(jax.device_put(jnp.zeros((16, 4)), rep),
+                      jax.device_put(jnp.zeros((16, 4)), rep),
+                      jax.device_put(jnp.ones((8,)), rep)),
+        donate_argnums=(0, 1), compile=False,
+        sharded_argnums=(1,), source=SRC))
     return records

@@ -66,4 +66,31 @@ def build_records():
     records.append(ProgramRecord(
         name="clean_full_bucket", bucket_capacity=8,
         bucket_rows_per_dispatch=8.0, source=SRC))
+
+    # honestly-sharded ZeRO-1 shape: optimizer state staged sharded at
+    # the call site, reduce-scatter/shard-local/all-gather constraints
+    # inside, donated — the clean side of bad_unsharded_optimizer
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    rep = NamedSharding(mesh, P())
+    sh = NamedSharding(mesh, P("dp"))
+
+    def sharded_opt(p, m, x):
+        g = jnp.mean(x) * jnp.ones_like(p)
+        g = jax.lax.with_sharding_constraint(g, sh)
+        ps = jax.lax.with_sharding_constraint(p, sh)
+        m2 = 0.9 * m + g
+        p2 = jax.lax.with_sharding_constraint(ps - 0.1 * m2, rep)
+        return p2, m2
+
+    records.append(ProgramRecord(
+        name="clean_sharded_optimizer", fn=sharded_opt,
+        example_args=(jax.device_put(jnp.zeros((16, 4)), rep),
+                      jax.device_put(jnp.zeros((16, 4)), sh),
+                      jax.device_put(jnp.ones((8,)), sh)),
+        donate_argnums=(0, 1), compile=False,
+        sharded_argnums=(1,), source=SRC))
     return records

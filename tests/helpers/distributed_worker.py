@@ -26,31 +26,46 @@ GLOBAL_BATCH = 32
 FEATURES = 5
 CLASSES = 3
 
+# zero1 gang geometry: every leading dim divisible by the dp extents
+# of BOTH a 3-proc x 2-device gang (dp=6) and the 2-proc x 2-device
+# gang it shrinks to (dp=4) — lcm 12 — so the optimizer state really
+# shards before AND after the reshard
+ZERO1_BATCH = 24
+ZERO1_FEATURES = 12
+ZERO1_HIDDEN = 24
+ZERO1_CLASSES = 12
 
-def build_net():
+
+def build_net(zero1: bool = False):
     from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
     from deeplearning4j_tpu.nn.conf import InputType
     from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
 
+    features = ZERO1_FEATURES if zero1 else FEATURES
+    hidden = ZERO1_HIDDEN if zero1 else 16
+    classes = ZERO1_CLASSES if zero1 else CLASSES
     conf = (NeuralNetConfiguration.Builder().seed(7).updater("adam")
             .learning_rate(1e-2).activation("tanh").weight_init("xavier")
             .list()
-            .layer(DenseLayer(n_out=16))
-            .layer(OutputLayer(n_out=CLASSES, loss="mcxent"))
-            .set_input_type(InputType.feed_forward(FEATURES))
+            .layer(DenseLayer(n_out=hidden))
+            .layer(OutputLayer(n_out=classes, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(features))
             .build())
     return MultiLayerNetwork(conf).init()
 
 
-def global_batch(step):
+def global_batch(step, zero1: bool = False):
     """Deterministic global batch for `step` (shared by the oracle in
     the test)."""
     import numpy as np
 
+    batch = ZERO1_BATCH if zero1 else GLOBAL_BATCH
+    features = ZERO1_FEATURES if zero1 else FEATURES
+    classes = ZERO1_CLASSES if zero1 else CLASSES
     rng = np.random.default_rng(1000 + step)
-    x = rng.normal(size=(GLOBAL_BATCH, FEATURES)).astype(np.float32)
-    labels = rng.integers(0, CLASSES, GLOBAL_BATCH)
-    y = np.eye(CLASSES, dtype=np.float32)[labels]
+    x = rng.normal(size=(batch, features)).astype(np.float32)
+    labels = rng.integers(0, classes, batch)
+    y = np.eye(classes, dtype=np.float32)[labels]
     return x, y
 
 
@@ -77,6 +92,9 @@ def main():
     # per-rank checkpoint copies: every rank writes its own
     # rank-<r>/ checkpoint dir — the divergence-quorum drill input
     ap.add_argument("--per-rank-ckpt", action="store_true")
+    # ZeRO-1 sharded optimizer state (engine/sharding.py): the worker
+    # trains with sharding="zero1" on the divisible-geometry net
+    ap.add_argument("--zero1", action="store_true")
     ap.add_argument("--guard", default="",
                     choices=("", "abort"))
     # per-step host-side sleep: widens the mid-step window so an
@@ -92,7 +110,7 @@ def main():
     import jax
     import numpy as np
 
-    net = build_net()
+    net = build_net(zero1=args.zero1)
     ckpt = (os.path.join(args.out_dir, "ckpt")
             if args.checkpoint_every else None)
     hb = wd = guard = None
@@ -131,15 +149,17 @@ def main():
         averaging_frequency=args.averaging_frequency,
         threshold_compression=args.threshold_compression,
         watchdog=wd, guard=guard,
-        per_rank_checkpoints=args.per_rank_ckpt)
+        per_rank_checkpoints=args.per_rank_ckpt,
+        sharding="zero1" if args.zero1 else None)
 
     def batch_fn(step):
         if args.spin_ms > 0:
             import time
 
             time.sleep(args.spin_ms / 1e3)
-        x, y = global_batch(step)
-        per = GLOBAL_BATCH // args.nprocs
+        x, y = global_batch(step, zero1=args.zero1)
+        gb = ZERO1_BATCH if args.zero1 else GLOBAL_BATCH
+        per = gb // args.nprocs
         s = args.pid * per
         return x[s:s + per], y[s:s + per]
 

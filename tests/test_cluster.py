@@ -766,6 +766,73 @@ def test_cluster_shrink_3_to_2_mid_run(tmp_path_factory):
 
 @pytest.mark.chaos
 @pytest.mark.slow
+def test_cluster_shrink_3_to_2_with_sharded_optimizer(
+        tmp_path_factory):
+    """Acceptance (sharded scale-out): the 3→2 shrink drill with
+    ZeRO-1 SHARDED optimizer state. Every rank checkpoints its own
+    optimizer-state SLICE next to the quorum-voted replicated main
+    copy; when rank 2 dies for good and the gang shrinks to 2, the
+    supervisor's sharded quorum votes over the SAVE-time world (rank
+    2's dir still votes and still contributes its slice), and the
+    relaunched workers reassemble all three slices and re-slice them
+    for the smaller world (resharding on resume,
+    dl4j_mesh_reshard_total). Final params are byte-compatible with a
+    NATIVE 2-worker zero1 gang resumed from the same checkpoint —
+    post-shrink training IS 2-world sharded training. The fast no-jax
+    twins of the slice/quorum math live in test_mesh.py."""
+    out = str(tmp_path_factory.mktemp("gang_shrink_z1"))
+    cs = _gang_supervisor(
+        out, nprocs=3, max_restarts_per_worker=0,
+        allow_shrink=True, min_workers=2, env=_worker_env(2),
+        extra=("--per-rank-ckpt", "--zero1"),
+        per_rank_checkpoints=True, sharded_optimizer=True,
+        env_fn=_one_shot_fault_env("train.step:raise@3", target_rank=2))
+    stats = cs.run(timeout_s=280.0)
+    assert stats["shrinks"] == 1
+    assert stats["world_size"] == 2
+    s = stats["resume_steps"][-1]
+    assert s >= 1
+    assert _final_world(out) == 2
+    # the elected step carried a complete slice set over the 3-rank
+    # save world
+    report = cs.quorum_reports[-1]
+    assert report["shard_world"] == 3
+    assert sorted(report["slices"]) == [0, 1, 2]
+    # sharded layout on disk: every rank wrote main + slice sidecar
+    for r in range(3):
+        d = rank_checkpoint_dir(os.path.join(out, "ckpt"), r)
+        fns = os.listdir(d)
+        assert any(fn.endswith(".updshard.npz") for fn in fns)
+
+    # native 2-world zero1 oracle resumed from a copy of the
+    # pre-shrink checkpoint state (steps > s pruned per rank dir so
+    # its own sharded quorum lands on the same shared resume step)
+    oracle_out = str(tmp_path_factory.mktemp("gang_shrink_z1_oracle"))
+    oracle_ckpt = os.path.join(oracle_out, "ckpt")
+    shutil.copytree(os.path.join(out, "ckpt"), oracle_ckpt)
+    from deeplearning4j_tpu.resilience import list_all_checkpoints
+
+    for r in range(3):
+        d = rank_checkpoint_dir(oracle_ckpt, r)
+        for step, fn in list_all_checkpoints(d):
+            if step > s:
+                os.remove(os.path.join(d, fn))
+                side = os.path.join(
+                    d, f"step-{step:08d}.updshard.npz")
+                if os.path.exists(side):
+                    os.remove(side)
+    cs_oracle = _gang_supervisor(
+        oracle_out, nprocs=2, env=_worker_env(2),
+        extra=("--per-rank-ckpt", "--zero1"),
+        per_rank_checkpoints=True, sharded_optimizer=True)
+    ostats = cs_oracle.run(timeout_s=280.0)
+    assert ostats["gang_restarts"] == 0
+    assert _final_world(oracle_out) == 2
+    _assert_parity(out, _final(oracle_out))
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
 def test_cluster_divergent_checkpoint_healed_by_quorum(
         tmp_path_factory):
     """Acceptance: a deliberately perturbed rank-1 checkpoint (a

@@ -533,7 +533,8 @@ PROGRAMS_FIX = TESTS / "fixtures" / "analysis_cases" / "programs"
 # reg-untested-registry-name discipline):
 #   prog-fp32-matmul-under-policy, prog-unhonored-donation,
 #   prog-transpose-churn, prog-hidden-host-transfer,
-#   prog-dead-output, prog-excess-padding
+#   prog-dead-output, prog-excess-padding,
+#   prog-unsharded-optimizer-state
 EXPECTED_BAD_PROGRAMS = {
     "prog-fp32-matmul-under-policy": "bad_fp32_matmul",
     "prog-unhonored-donation": "bad_unhonored_donation",
@@ -541,6 +542,7 @@ EXPECTED_BAD_PROGRAMS = {
     "prog-hidden-host-transfer": "bad_host_transfer",
     "prog-dead-output": "bad_dead_output",
     "prog-excess-padding": "bad_excess_padding",
+    "prog-unsharded-optimizer-state": "bad_unsharded_optimizer",
 }
 
 

@@ -47,8 +47,16 @@ if __name__ == "__main__":
     sys.path.insert(0, str(ROOT))
     if "--programs" in sys.argv[1:]:
         # program mode executes the real package (it builds nets and
-        # serving front-ends); pin the platform before jax loads
+        # serving front-ends); pin the platform before jax loads, and
+        # give the host platform enough virtual devices that the
+        # mesh-sharded (ZeRO-1) record compiles over a REAL dp axis —
+        # prog-unsharded-optimizer-state is vacuous on one device
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        _flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in _flags:
+            os.environ["XLA_FLAGS"] = (
+                _flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
         from deeplearning4j_tpu.analysis import runner
     else:
         runner = _load_analysis_package()
