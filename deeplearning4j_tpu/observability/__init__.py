@@ -19,6 +19,7 @@ from deeplearning4j_tpu.observability.metrics import (  # noqa: F401
     get_registry,
     observe,
     parse_prometheus,
+    parse_prometheus_snapshot,
     set_gauge,
     telemetry_enabled,
 )

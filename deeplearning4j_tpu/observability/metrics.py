@@ -32,6 +32,7 @@ must be emitted somewhere and exercised by at least one test.
 from __future__ import annotations
 
 import bisect
+import re
 import threading
 import time
 from collections import deque
@@ -89,6 +90,15 @@ REGISTERED_METRICS = frozenset({
     "dl4j_serving_load_rejected_total",
     "dl4j_serving_active_models",
     "dl4j_serving_replica_failovers_total",
+    # fleet rollout controller (serving/controller.py)
+    "dl4j_fleet_replicas",
+    "dl4j_fleet_scale_events_total",
+    "dl4j_fleet_replica_deaths_total",
+    "dl4j_rollout_state",
+    "dl4j_rollout_total",
+    "dl4j_rollout_rollbacks_total",
+    "dl4j_rollout_holddowns_total",
+    "dl4j_rollout_detection_seconds",
     "dl4j_jit_traces_total",
     "dl4j_jit_compiles_total",
     # performance introspection (observability/perf.py)
@@ -388,6 +398,71 @@ def render_prometheus(snap: dict) -> str:
         lines.append(f"{base}_sum{suffix} {_fmt(h['sum'])}")
         lines.append(f"{base}_count{suffix} {h['count']}")
     return "\n".join(lines) + "\n"
+
+
+_LABEL_PAIR = re.compile(r'(\w+)="([^"]*)"')
+
+
+def parse_prometheus_snapshot(text: str) -> dict:
+    """Parse exposition text back into a `MetricsRegistry.snapshot()`-
+    shaped dict — the inverse of `render_prometheus` (ring quantiles
+    cannot survive the wire and come back as None; histogram bucket
+    counts are de-cumulated back to per-bucket form).
+
+    This is the scrape half of fleet-level aggregation: a controller
+    scrapes each replica's /metrics body, rebuilds snapshots with this,
+    and merges them through `perf.aggregate_snapshots` — the same merge
+    path the cross-rank training exposition uses."""
+    types: Dict[str, str] = {}
+    snap: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+    hist_raw: Dict[str, dict] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        full, _, val = line.rpartition(" ")
+        try:
+            value = float(val)
+        except ValueError:
+            continue
+        base, lab = _split_hist_name(full)
+        for suffix in ("_bucket", "_sum", "_count"):
+            if base.endswith(suffix) \
+                    and types.get(base[:-len(suffix)]) == "histogram":
+                hname = base[:-len(suffix)]
+                pairs = _LABEL_PAIR.findall(lab)
+                le = dict(pairs).get("le")
+                rest = sorted((k, v) for k, v in pairs if k != "le")
+                series_key = hname + _label_str(tuple(rest))
+                h = hist_raw.setdefault(
+                    series_key, {"count": 0, "sum": 0.0, "cum": []})
+                if suffix == "_bucket" and le is not None:
+                    h["cum"].append((le, value))
+                elif suffix == "_sum":
+                    h["sum"] = value
+                else:
+                    h["count"] = int(value)
+                break
+        else:
+            kind = types.get(base)
+            tgt = snap["gauges"] if kind == "gauge" else snap["counters"]
+            tgt.setdefault(base, {})[
+                "{" + lab + "}" if lab else ""] = value
+    for series_key, h in hist_raw.items():
+        cum = sorted(h["cum"], key=_bucket_order)
+        buckets, prev = {}, 0
+        for le, c in cum:
+            buckets[le] = int(c) - prev
+            prev = int(c)
+        snap["histograms"][series_key] = {
+            "count": h["count"], "sum": h["sum"], "buckets": buckets,
+            "p50": None, "p90": None, "p99": None}
+    return snap
 
 
 def parse_prometheus(text: str) -> Dict[str, float]:

@@ -584,6 +584,12 @@ class ModelServer:
 
                 def _run():
                     _fire("serve.request")
+                    # chaos drill: an armed `rollout.canary_poison`
+                    # degrades THIS replica's serving — mode=delay adds
+                    # latency, mode=raise turns the request into a 500;
+                    # the FleetController's canary SLO watch must catch
+                    # either shape and auto-roll the canary back
+                    _fire("rollout.canary_poison")
                     binary = NPZ_CONTENT_TYPE in (
                         self.headers.get("Content-Type") or "")
                     req = (decode_npz_request(self._read_raw())

@@ -137,11 +137,35 @@ class ModelNotFoundError(ResilienceError):
 class NoHealthyReplicaError(ResilienceError):
     """Every replica behind a ReplicaRouter is open-circuited or
     failed the request — there is nowhere left to fail over to.
-    `cause` is the last replica's failure."""
+    `cause` is the last replica's failure; `causes` is every
+    per-replica failure as (url, exception) pairs (a caller can tell
+    "everyone shed me" from "everyone was unreachable"); `membership`
+    is the router's fleet membership (replica URLs) at failure time,
+    so a chaos drill can assert WHICH fleet had nowhere left to go."""
 
-    def __init__(self, msg: str, cause: Exception | None = None):
+    def __init__(self, msg: str, cause: Exception | None = None,
+                 membership: list | None = None,
+                 causes: list | None = None):
         super().__init__(msg)
         self.cause = cause
+        self.membership = list(membership or [])
+        self.causes = list(causes or [])
+
+
+class RolloutHeldError(ResilienceError):
+    """The FleetController's hold-down ledger refused to re-canary a
+    version that recently failed its SLO watch — a bad build cannot be
+    re-rolled in a tight loop. `until_s` is the monotonic time the
+    hold-down expires; `failures` how many rollouts of this (model,
+    version) have been rolled back so far."""
+
+    def __init__(self, msg: str, model: str = "", version: str = "",
+                 until_s: float = 0.0, failures: int = 0):
+        super().__init__(msg)
+        self.model = model
+        self.version = version
+        self.until_s = until_s
+        self.failures = failures
 
 
 class ServingError(ResilienceError):

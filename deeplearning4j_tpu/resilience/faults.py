@@ -50,6 +50,23 @@ Fault points wired through the stack:
                      telemetry backend; the emission helpers swallow it
                      (counted as dropped), proving no step or request
                      can ever fail because of telemetry
+  rollout.canary_poison  ModelServer predict handler, once per request —
+                     `delay` degrades the replica's serving latency,
+                     `raise` turns requests into 500s: the deterministic
+                     analogue of a bad model version reaching a canary.
+                     The FleetController's SLO watch must detect either
+                     degradation and auto-roll the canary back
+  serving.replica_kill  FleetController health poll, once per replica
+                     per tick — `raise` is consumed as a forced
+                     "this replica is dead" verdict (the SIGKILL drill
+                     without a real process kill): the controller
+                     removes it from the router and backfills from the
+                     replica factory
+  admission.quota_storm  AdmissionController.admit, once per decision —
+                     `raise` is consumed as a forced quota shed for
+                     METERED tenants (unmetered/high classes are
+                     untouched): a synthetic quota storm that must land
+                     on the metered classes without starving gold
 
 `REGISTERED_POINTS` is the canonical registry: every `fire(...)` site
 in the package must use a name listed there, and the test suite pins
@@ -85,6 +102,7 @@ _MODES = ("raise", "delay", "truncate")
 # tests/test_selfhealing.py asserts source sites and this registry agree
 # and that each point is exercised by at least one test
 REGISTERED_POINTS = frozenset({
+    "admission.quota_storm",
     "checkpoint.write",
     "data.next",
     "dist.heartbeat_stale",
@@ -92,7 +110,9 @@ REGISTERED_POINTS = frozenset({
     "inference.batch",
     "inference.complete",
     "obs.emit",
+    "rollout.canary_poison",
     "serve.request",
+    "serving.replica_kill",
     "train.grad_nonfinite",
     "train.hang",
     "train.hang_hard",

@@ -11,11 +11,17 @@ control plane that makes it multi-model and multi-tenant:
                 priority classes with shed-lowest-first load shedding
                 in front of the bounded queue;
   router.py     ReplicaRouter — client-side least-outstanding spreading
-                over N ModelServer replicas with CircuitBreaker health
-                and automatic failover.
+                over N ModelServer replicas with CircuitBreaker health,
+                automatic failover, and runtime membership
+                (add_replica/remove_replica with in-flight draining);
+  controller.py FleetController — the fleet supervisor: canary/ramp
+                rollouts auto-rolled-back on SLO breach (hold-down
+                ledger against tight relaunch loops), metric-driven
+                autoscaling of the replica pool, replica-death
+                detection + backfill, fleet-level metric aggregation.
 
 The HTTP surface (the /v1/models routes) lives on ModelServer in
-parallel/serving.py, which consumes all three.
+parallel/serving.py, which consumes all of these.
 """
 
 from deeplearning4j_tpu.serving.admission import (  # noqa: F401
@@ -30,9 +36,19 @@ from deeplearning4j_tpu.serving.registry import (  # noqa: F401
     ModelRegistry,
 )
 from deeplearning4j_tpu.serving.router import ReplicaRouter  # noqa: F401
+from deeplearning4j_tpu.serving.controller import (  # noqa: F401
+    ROLLOUT_STATES,
+    FleetController,
+    HttpReplica,
+    LocalReplica,
+    SLOPolicy,
+    slo_sample,
+)
 
 __all__ = [
-    "DEFAULT_SHED_THRESHOLDS", "PRIORITY_CLASSES",
+    "DEFAULT_SHED_THRESHOLDS", "PRIORITY_CLASSES", "ROLLOUT_STATES",
     "AdmissionController", "TenantConfig", "TokenBucket",
     "ModelEntry", "ModelRegistry", "ReplicaRouter",
+    "FleetController", "HttpReplica", "LocalReplica", "SLOPolicy",
+    "slo_sample",
 ]

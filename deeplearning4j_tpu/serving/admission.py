@@ -32,7 +32,11 @@ import time
 from typing import Dict, Optional
 
 from deeplearning4j_tpu.observability import metrics as _obs
-from deeplearning4j_tpu.resilience.errors import QuotaExceededError
+from deeplearning4j_tpu.resilience.errors import (
+    FaultInjectedError,
+    QuotaExceededError,
+)
+from deeplearning4j_tpu.resilience.faults import fire as _fire
 
 # priority classes, lowest number = most important = shed last
 PRIORITY_CLASSES = {"high": 0, "normal": 1, "low": 2}
@@ -155,7 +159,17 @@ class AdmissionController:
         cfg = self.config_for(tenant)
         tname = tenant or cfg.name
         labels = {"tenant": tname, "priority": cfg.priority}
-        if cfg.bucket is not None and not cfg.bucket.try_take():
+        # chaos drill: an armed `admission.quota_storm` raise is
+        # consumed as a forced quota shed for METERED tenants only —
+        # the synthetic storm drains token buckets, so unmetered
+        # classes (gold) ride through it untouched
+        storm = False
+        try:
+            _fire("admission.quota_storm")
+        except FaultInjectedError:
+            storm = cfg.bucket is not None
+        if storm or (cfg.bucket is not None
+                     and not cfg.bucket.try_take()):
             with self._lock:
                 self.counters["shed_quota"] += 1
             _obs.count("dl4j_serving_shed_total",

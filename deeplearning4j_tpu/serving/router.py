@@ -15,14 +15,25 @@ proves liveness); the router adds:
              logical call. Responses that prove the server is alive
              but unhappy (400/404/429/500) surface immediately:
              another replica would answer the same.
+  membership `add_replica`/`remove_replica` at runtime — the
+             FleetController's autoscaler grows and shrinks the pool
+             through these. Removal DRAINS by default: the replica
+             stops being picked immediately, and the call blocks
+             (bounded) until its in-flight requests finish. A replica
+             removed mid-flight (autoscale shrink, replica kill) still
+             fails over, but the failure is NOT counted against the
+             removed replica's accounting — an orchestrated removal is
+             not replica badness.
 
-`NoHealthyReplicaError` (with the last failure as `cause`) is raised
-only when every replica has been tried or is open-circuited.
+`NoHealthyReplicaError` (with the last failure as `cause` and the
+fleet `membership` snapshot at failure time) is raised only when every
+replica has been tried or is open-circuited.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, List, Optional
 
 from deeplearning4j_tpu.observability import metrics as _obs
@@ -50,7 +61,7 @@ def _default_factory(timeout: float):
 
 class _Replica:
     __slots__ = ("url", "client", "outstanding", "requests",
-                 "failures")
+                 "failures", "draining")
 
     def __init__(self, url: str, client):
         self.url = url
@@ -58,11 +69,14 @@ class _Replica:
         self.outstanding = 0
         self.requests = 0
         self.failures = 0
+        self.draining = False
 
 
 class ReplicaRouter:
     """Spread requests across ModelServer replicas with
-    least-outstanding picking and automatic failover.
+    least-outstanding picking, automatic failover, and runtime
+    membership (`add_replica`/`remove_replica` with in-flight
+    draining).
 
     `client_factory(url)` defaults to a ModelClient with its stock
     CircuitBreaker and retry policy; inject a factory to tune either
@@ -72,24 +86,77 @@ class ReplicaRouter:
                  client_factory: Optional[Callable] = None):
         if not urls:
             raise ValueError("ReplicaRouter needs at least one URL")
-        factory = client_factory or _default_factory(timeout)
-        self._replicas = [_Replica(u.rstrip("/"), factory(u))
+        self._factory = client_factory or _default_factory(timeout)
+        self._replicas = [_Replica(u.rstrip("/"), self._factory(u))
                           for u in urls]
         self._lock = threading.Lock()
         self._rr = 0
         self.failovers = 0
 
+    # ----------------------------------------------------- membership
+    def urls(self) -> List[str]:
+        """Current fleet membership (draining replicas included — they
+        are still finishing in-flight work)."""
+        with self._lock:
+            return [r.url for r in self._replicas]
+
+    def add_replica(self, url: str, client=None) -> None:
+        """Join a replica to the pool; it becomes pickable
+        immediately. `client` defaults to one from the router's
+        factory."""
+        url = url.rstrip("/")
+        with self._lock:
+            if any(r.url == url for r in self._replicas):
+                raise ValueError(f"replica {url!r} is already a member")
+        # client construction stays outside the lock (it may do I/O)
+        replica = _Replica(url, client if client is not None
+                           else self._factory(url))
+        with self._lock:
+            if any(r.url == url for r in self._replicas):
+                raise ValueError(f"replica {url!r} is already a member")
+            self._replicas.append(replica)
+
+    def remove_replica(self, url: str, drain: bool = True,
+                       drain_timeout_s: float = 10.0) -> bool:
+        """Leave the pool. The replica stops being picked immediately;
+        with `drain=True` the call waits (bounded) for its in-flight
+        requests to finish before membership drops. Returns True when
+        the replica left with zero requests still in flight."""
+        url = url.rstrip("/")
+        with self._lock:
+            target = next((r for r in self._replicas if r.url == url),
+                          None)
+            if target is None:
+                raise ValueError(f"no replica {url!r} in the pool")
+            target.draining = True
+        deadline = time.monotonic() + (drain_timeout_s if drain else 0.0)
+        while True:
+            with self._lock:
+                clear = target.outstanding == 0
+            if clear or time.monotonic() >= deadline:
+                break
+            time.sleep(0.005)
+        with self._lock:
+            self._replicas = [r for r in self._replicas
+                              if r is not target]
+        return clear
+
+    def _is_member(self, replica: _Replica) -> bool:
+        with self._lock:
+            return any(r is replica for r in self._replicas) \
+                and not replica.draining
+
     # -------------------------------------------------------- picking
     def _pick(self, exclude: set) -> Optional[_Replica]:
-        """Least outstanding among breaker-admitting replicas not yet
-        tried for this request; round-robin offset breaks ties so
-        idle-equal replicas alternate."""
+        """Least outstanding among breaker-admitting, non-draining
+        replicas not yet tried for this request; round-robin offset
+        breaks ties so idle-equal replicas alternate."""
         with self._lock:
             n = len(self._replicas)
             best, best_key = None, None
             for i in range(n):
                 r = self._replicas[(self._rr + i) % n]
-                if r.url in exclude:
+                if r.url in exclude or r.draining:
                     continue
                 if r.client.breaker is not None \
                         and not r.client.breaker.allow():
@@ -112,8 +179,9 @@ class ReplicaRouter:
     # -------------------------------------------------------- calling
     def _call(self, fn: Callable[[_Replica], dict]) -> dict:
         tried: set = set()
+        causes: list = []
         last: Optional[Exception] = None
-        for _ in range(len(self._replicas)):
+        while True:
             r = self._pick(tried)
             if r is None:
                 break
@@ -121,26 +189,37 @@ class ReplicaRouter:
             try:
                 out = fn(r)
             except _FAILOVER as exc:
-                self._release(r, failed=True)
-                last = exc
-                with self._lock:
-                    self.failovers += 1
-                _obs.count("dl4j_serving_replica_failovers_total")
-                continue
-            except ServingError as exc:
-                self._release(r, failed=exc.retryable)
-                if exc.retryable:   # 503/429: the replica is drowning
+                # a replica removed mid-flight (shrink or kill) fails
+                # over WITHOUT the failure counting against it — the
+                # removal was orchestrated, not replica badness
+                removed = not self._is_member(r)
+                self._release(r, failed=not removed)
+                if not removed:
                     last = exc
+                    causes.append((r.url, exc))
                     with self._lock:
                         self.failovers += 1
                     _obs.count("dl4j_serving_replica_failovers_total")
+                continue
+            except ServingError as exc:
+                removed = not self._is_member(r)
+                self._release(r, failed=exc.retryable and not removed)
+                if exc.retryable:   # 503/429: the replica is drowning
+                    if not removed:
+                        last = exc
+                        causes.append((r.url, exc))
+                        with self._lock:
+                            self.failovers += 1
+                        _obs.count(
+                            "dl4j_serving_replica_failovers_total")
                     continue
                 raise               # 400/404/500: same answer anywhere
             self._release(r, failed=False)
             return out
         raise NoHealthyReplicaError(
             f"no healthy replica answered (tried {sorted(tried)}; "
-            f"last: {last!r})", cause=last)
+            f"last: {last!r})", cause=last, membership=self.urls(),
+            causes=causes)
 
     def predict(self, inputs, model: Optional[str] = None,
                 tenant: Optional[str] = None,
@@ -161,6 +240,7 @@ class ReplicaRouter:
                     "outstanding": r.outstanding,
                     "requests": r.requests,
                     "failures": r.failures,
+                    "draining": r.draining,
                     "breaker": (r.client.breaker.state
                                 if r.client.breaker is not None
                                 else None),

@@ -385,6 +385,26 @@ def telemetry_lines(snapshot) -> list:
             mesh.append(
                 f"allgather {ag['sum'] / ag['count'] * 1e3:.1f}ms avg")
         lines.append("mesh — " + " · ".join(mesh))
+    # fleet rollout controller (serving/controller.py): pool size,
+    # rollout state-machine position, rollback count
+    fleet_n = gauge("dl4j_fleet_replicas")
+    rollout_state = gauge("dl4j_rollout_state")
+    if fleet_n is not None or rollout_state is not None \
+            or "dl4j_rollout_rollbacks_total" in c:
+        # mirror of serving.controller.ROLLOUT_STATES (equality pinned
+        # by test) — importing the serving package here would drag the
+        # whole data plane into every dashboard render
+        ROLLOUT_STATES = ("idle", "canary", "ramping", "rolling_back",
+                          "held", "completed")
+        fleet = []
+        if fleet_n is not None:
+            fleet.append(f"{int(fleet_n)} replicas")
+        state_i = int(rollout_state) if rollout_state is not None else 0
+        if 0 <= state_i < len(ROLLOUT_STATES):
+            fleet.append(f"rollout {ROLLOUT_STATES[state_i]}")
+        fleet.append(
+            f"{c.get('dl4j_rollout_rollbacks_total', 0)} rollbacks")
+        lines.append("fleet — " + " · ".join(fleet))
     if "dl4j_serving_requests_total" in c:
         serv = [f"{c['dl4j_serving_requests_total']} requests "
                 f"({c.get('dl4j_serving_errors_total', 0)} errors)"]

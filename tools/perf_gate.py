@@ -41,13 +41,21 @@ def find_family(directory: str, family: str):
     "r" is the flagship BENCH_r*.json round series; any other family F
     selects BENCH_F_*.json — A/B pairs order their `_off` (baseline)
     arm first, so `--metric pipeline` gates BENCH_pipeline_on.json
-    against BENCH_pipeline_off.json."""
+    against BENCH_pipeline_off.json. A plain BENCH_F.json (the
+    headline artifact of a chaos/soak bench) sorts LAST, so
+    `--metric serving_chaos` gates BENCH_serving_chaos.json against
+    its BENCH_serving_chaos_off.json control arm."""
     if family == "r":
         return find_rounds(directory)
     paths = glob.glob(os.path.join(directory, f"BENCH_{family}_*.json"))
+    exact = os.path.join(directory, f"BENCH_{family}.json")
+    if os.path.exists(exact):
+        paths.append(exact)
 
     def key(path):
         name = os.path.basename(path)
+        if name == f"BENCH_{family}.json":
+            return (2, name)
         return (0 if name.endswith("_off.json") else 1, name)
 
     return sorted(paths, key=key)
