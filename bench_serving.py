@@ -38,6 +38,19 @@ Modes:
       load, no chaos) to BENCH_serving_chaos_off.json and the chaos
       arm to BENCH_serving_chaos.json on gold goodput, gated by
       `python tools/perf_gate.py --metric serving_chaos`.
+  python bench_serving.py decode [n_requests]
+      continuous-batching A/B (ROADMAP 3a): one CausalTransformer
+      decoder served twice over the SAME warmed compiled programs on a
+      mixed prompt-length (4-48) / output-length (8-48) request set.
+      OFF = naive per-request serving: each request prefills and then
+      pays one decode dispatch per token ALONE (sequential_decode, the
+      oracle loop). ON = the DecodeEngine packing the same requests
+      into max_slots concurrent streams — same dispatch count per
+      step, up to max_slots tokens per dispatch. Token outputs of the
+      two arms are asserted IDENTICAL (the byte-identity bar) before
+      any rate is reported. Writes BENCH_decode_off.json /
+      BENCH_decode_on.json on decode_tokens_per_sec, gated by
+      `python tools/perf_gate.py --metric decode`.
   python bench_serving.py soak [duration_s] [out.json]
       mixed-tenant multi-model control-plane soak: 2 real models × 3
       tenants with skewed priorities (gold=high, silver=normal,
@@ -1092,7 +1105,105 @@ def bench_chaos_soak(duration_s=24.0,
             _hard_kill(s)
 
 
+def bench_decode(n_requests=64, max_slots=8, seed=0):
+    """Continuous batching vs naive per-request decode on one shared
+    model (config in the module docstring). Returns (off_doc, on_doc)
+    on decode_tokens_per_sec; raises if the two arms' token outputs
+    are not identical."""
+    import random
+
+    from deeplearning4j_tpu.engine.decode_program import DecodeProgram
+    from deeplearning4j_tpu.serving.continuous import (
+        DecodeEngine,
+        sequential_decode,
+    )
+    from deeplearning4j_tpu.zoo.decoder import CausalTransformer
+
+    model = CausalTransformer(vocab_size=512, d_model=128, n_heads=8,
+                              n_layers=4, max_ctx=128, seed=7).init()
+    prog = DecodeProgram(model, max_slots=max_slots, page_size=16)
+    rng = random.Random(seed)
+    reqs = [([rng.randrange(model.vocab_size)
+              for _ in range(rng.randrange(4, 49))],
+             rng.randrange(8, 49)) for _ in range(n_requests)]
+
+    # warmup: every prefill bucket the request set will touch + the
+    # decode step — both arms then run compile-free
+    buckets = sorted({prog.bucket(len(p)) for p, _ in reqs})
+    prog.warmup(prog.init_kv(), buckets=buckets)
+
+    def run_naive():
+        kv = prog.init_kv()
+        outs = []
+        t0 = time.perf_counter()
+        for prompt, mx in reqs:
+            kv, toks = sequential_decode(prog, prompt, mx, kv=kv)
+            outs.append(toks)
+        return outs, time.perf_counter() - t0
+
+    def run_continuous():
+        eng = DecodeEngine(program=prog, queue_limit=n_requests,
+                           max_prefills_per_step=2)
+        t0 = time.perf_counter()
+        handles = [eng.submit(p, mx) for p, mx in reqs]
+        while any(not h.done for h in handles):
+            eng.step_once()
+        dt = time.perf_counter() - t0
+        return [h.result(timeout_s=0) for h in handles], dt, eng
+
+    # interleave 2 reps per arm; best rep is the headline (transients
+    # only ever slow a rep down — PERF.md hygiene)
+    naive_outs, naive_dt = run_naive()
+    cont_outs, cont_dt, eng = run_continuous()
+    n2, ndt2 = run_naive()
+    c2, cdt2, _ = run_continuous()
+    if not (naive_outs == cont_outs == n2 == c2):
+        raise AssertionError(
+            "continuous-batched tokens diverged from the sequential "
+            "per-request arm — byte-identity bar failed")
+    naive_dt = min(naive_dt, ndt2)
+    cont_dt = min(cont_dt, cdt2)
+    tokens = sum(len(t) for t in naive_outs)
+    steps = eng.stats()["steps"]
+    config = (f"CausalTransformer v{model.vocab_size} d{model.d_model}"
+              f" h{model.n_heads} L{model.n_layers} ctx{model.max_ctx}"
+              f" f32; {n_requests} requests, prompts 4-48, outputs "
+              f"8-48, max_slots={max_slots} page=16; identical token "
+              f"outputs asserted between arms")
+    base = {"metric": "decode_tokens_per_sec", "unit": "tok/s",
+            "tokens": tokens, "requests": n_requests, "config": config}
+    off_doc = dict(base, value=round(tokens / naive_dt, 1),
+                   wall_s=round(naive_dt, 3), mode="naive_per_request")
+    on_doc = dict(base, value=round(tokens / cont_dt, 1),
+                  wall_s=round(cont_dt, 3), mode="continuous_batching",
+                  vs_baseline=round(naive_dt / cont_dt, 3),
+                  decode_steps=steps,
+                  mean_slot_occupancy=round(
+                      tokens / max(steps, 1), 2))
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        for doc in (off_doc, on_doc):
+            doc["device"] = str(dev.device_kind)
+            doc["platform"] = str(dev.platform)
+            doc["jax"] = jax.__version__
+    except Exception:   # noqa: BLE001 - device facts are best-effort
+        pass
+    return off_doc, on_doc
+
+
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "decode":
+        n = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+        off_doc, on_doc = bench_decode(n_requests=n)
+        with open("BENCH_decode_off.json", "w") as f:
+            json.dump(off_doc, f, indent=2)
+        with open("BENCH_decode_on.json", "w") as f:
+            json.dump(on_doc, f, indent=2)
+        print(json.dumps(on_doc))
+        return
+
     if len(sys.argv) > 1 and sys.argv[1] == "chaos-soak":
         duration = float(sys.argv[2]) if len(sys.argv) > 2 else 24.0
         out_path = sys.argv[3] if len(sys.argv) > 3 \

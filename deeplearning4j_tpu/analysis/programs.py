@@ -16,6 +16,14 @@ registration) but at CPU-lintable dims:
                               CPU devices so the dp axis is real)
   serving_predict / buckets   ParallelInference warmup + a short driven
                               load, so bucket fill is MEASURED
+  decode_step / decode_prefill  the continuous-batching decode engine
+                              (engine/decode_program.py): the shared
+                              [max_slots] decode step and one pow2
+                              prefill bucket, KV-cache donation
+                              DECLARED so prog-unhonored-donation
+                              verifies no silent per-token copy of the
+                              [n_layers, 2, max_slots, max_ctx, ...]
+                              buffer
   clustering_kmeans_lloyd     the donated Lloyd iteration
   clustering_tsne_step        the donated embedding step (the program
                               whose dropped donation the first audit
@@ -204,9 +212,23 @@ def build_default_records() -> List[ProgramRecord]:
     records += _engine_records()
     records += _mesh_records()
     records += _serving_records()
+    records += _decode_records()
     records += _clustering_records()
     records += _flagship_records()
     return records
+
+
+def _decode_records() -> List[ProgramRecord]:
+    """The continuous-batching decode programs at CPU-lintable dims,
+    built through the same JitCache paths DecodeEngine runs (policy
+    registered, donation declared on the paged KV cache)."""
+    from deeplearning4j_tpu.engine.decode_program import DecodeProgram
+    from deeplearning4j_tpu.zoo.decoder import CausalTransformer
+
+    model = CausalTransformer(vocab_size=64, d_model=32, n_heads=4,
+                              n_layers=2, max_ctx=64, seed=17).init()
+    prog = DecodeProgram(model, max_slots=4, page_size=16)
+    return prog.lint_records(buckets=(16,))
 
 
 def _mesh_records() -> List[ProgramRecord]:

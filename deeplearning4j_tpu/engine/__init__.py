@@ -69,8 +69,10 @@ from deeplearning4j_tpu.engine.step_program import (
     StepProgram,
     make_loss_and_apply,
 )
+from deeplearning4j_tpu.engine.decode_program import DecodeProgram
 
 __all__ = ["StepProgram", "StepHarness", "make_loss_and_apply",
            "StepPrefetcher", "IteratorPipeline", "stack_staged",
            "SKIPPED", "MeshManager", "zero1_leaf_sharded",
-           "slice_bounds", "slice_rows", "assemble_rows", "reslice"]
+           "slice_bounds", "slice_rows", "assemble_rows", "reslice",
+           "DecodeProgram"]

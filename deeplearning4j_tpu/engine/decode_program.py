@@ -1,0 +1,329 @@
+"""DecodeProgram: the compiled half of continuous-batching decode.
+
+The serving sibling of StepProgram — one model's autoregressive
+programs, compiled ONCE per shape and never again (the static-shape
+constraint that makes one-program XLA serving work at all, per
+"Automatic Full Compilation ... to Cloud TPUs", arXiv 1810.09868):
+
+  decode step   ONE program over the engine's fixed [max_slots] batch:
+                consume each slot's current token at its current
+                position, write that position's K/V into the slot's
+                cache pages (donated, in-place), attend under per-slot
+                length masks, emit each slot's greedy next token.
+                Requests joining/leaving slots is pure DATA — the
+                compiled shape never changes, so arbitrary join/leave
+                traffic runs on one compile (pinned by trace counters).
+  prefill       one program per pow2, page-aligned prompt bucket
+                [bucket_len]: process a whole prompt window in
+                parallel, park its K/V pages into the target slot
+                (donated cache write via dynamic_update_slice), return
+                the prompt's first generated token. The phase split —
+                long prompts cost one bucketed dispatch instead of L
+                serial decode steps, and never reshape the shared
+                decode program.
+
+KV-cache layout (the tensor-layout discipline of Tensor Processing
+Primitives, arXiv 2104.05755): ONE preallocated buffer
+``[n_layers, 2, max_slots, n_heads, max_ctx, head_dim]`` — HEAD-MAJOR
+so both decode attention contractions batch over leading (slot, head)
+dims and contract the minor axis in place (the first slot-major
+attempt made XLA transpose 40% of program traffic per step — caught
+by prog-transpose-churn, documented in PERF.md), position pages
+contiguous per (slot, head) so a bucketed prefill fills
+``bucket_len/page_size`` whole pages in one slice write, head_dim
+innermost for lane alignment. Both programs DONATE the cache buffer:
+the update is in-place, the caller rebinds — program-lint's
+prog-unhonored-donation rule verifies the alias map actually honors
+it (a silent copy of this buffer per token is the regression the rule
+exists to catch; decode/prefill join the --programs representative
+set).
+
+Forensics / policy / MFU ride the exact StepProgram rails: programs
+live in the model's JitCache (record_trace inside traced bodies,
+register_policy per key) and `register_perf` attaches XLA cost-model
+entries so MFU gauges and compile-event cost digests follow.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+class DecodeProgram:
+    """One CausalTransformer's compiled prefill/decode programs over a
+    fixed slot batch. Holds NO request state — serving/continuous.py's
+    DecodeEngine owns slots; this class owns shapes, compilation, and
+    the cache layout."""
+
+    def __init__(self, model, max_slots: int = 8, page_size: int = 16):
+        if page_size & (page_size - 1):
+            raise ValueError(f"page_size must be a power of two "
+                             f"(page-aligned pow2 buckets): {page_size}")
+        if model.params is None:
+            model.init()
+        self.model = model
+        self.max_slots = int(max_slots)
+        self.page_size = int(min(page_size, model.max_ctx))
+        from deeplearning4j_tpu.nn.jit_cache import policy_name
+
+        self.precision_policy = policy_name(
+            getattr(model, "compute_dtype", None))
+
+    # ---------------------------------------------------------- layout
+    @property
+    def kv_shape(self) -> Tuple[int, ...]:
+        m = self.model
+        return (m.n_layers, 2, self.max_slots, m.n_heads, m.max_ctx,
+                m.head_dim)
+
+    def init_kv(self):
+        """The preallocated paged KV cache (zeros; pages are always
+        overwritten before they are readable under the length masks)."""
+        import jax.numpy as jnp
+
+        return jnp.zeros(self.kv_shape, jnp.float32)
+
+    def bucket(self, prompt_len: int) -> int:
+        """Pow2, page-aligned prefill bucket for a prompt length —
+        floor `page_size`, cap `max_ctx`. One compiled prefill program
+        serves every prompt in the bucket (shorter prompts pad; the
+        pad rows write only pages the decode masks keep unreadable)."""
+        if prompt_len < 1:
+            raise ValueError("prompt must carry at least one token")
+        if prompt_len > self.model.max_ctx:
+            raise ValueError(
+                f"prompt length {prompt_len} exceeds max_ctx "
+                f"{self.model.max_ctx}")
+        return min(self.model.max_ctx,
+                   max(self.page_size, next_pow2(prompt_len)))
+
+    # ------------------------------------------------------- compile
+    def decode_key(self):
+        return ("decode_step", self.max_slots, self.model.max_ctx)
+
+    def prefill_key(self, bucket_len: int):
+        return ("decode_prefill", int(bucket_len), self.max_slots,
+                self.model.max_ctx)
+
+    def _decode_program(self):
+        cache = self.model._jit_cache
+        key = self.decode_key()
+        if key not in cache:
+            cache[key] = self._build_decode(str(key))
+            cache.register_policy(key, self.precision_policy)
+        return cache[key]
+
+    def _prefill_program(self, bucket_len: int):
+        cache = self.model._jit_cache
+        key = self.prefill_key(bucket_len)
+        if key not in cache:
+            cache[key] = self._build_prefill(bucket_len, str(key))
+            cache.register_policy(key, self.precision_policy)
+        return cache[key]
+
+    def _build_decode(self, trace_key: str):
+        """Compile the shared decode step. Per-slot independence is
+        the load-bearing property: no op mixes slots (batched einsums,
+        per-row norms/softmax), so an active slot's emitted token is a
+        function of ITS tokens alone — the byte-identity-under-churn
+        contract tests/test_decode.py pins against the sequential
+        oracle."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nn.attention import (
+            block_decode_finish,
+            decode_qkv,
+            layer_norm,
+            lm_logits,
+        )
+
+        model = self.model
+        n_heads = model.n_heads
+        cache = model._jit_cache
+        # advanced-index triplet for the per-(slot, head) cache write:
+        # kv[li, io, s, h, positions[s]] = k[s, h] — the slot/head axes
+        # broadcast against the per-slot position vector
+        sidx = np.arange(self.max_slots)[:, None]
+        hidx = np.arange(model.n_heads)[None, :]
+
+        def decode_fn(params, kv, tokens, positions):
+            cache.record_trace(trace_key)
+            x = params["tok_emb"][tokens] + params["pos_emb"][positions]
+            pos2 = positions[:, None]
+            for li, lp in enumerate(params["layers"]):
+                q, k, v = decode_qkv(lp, x, n_heads)
+                kv = kv.at[li, 0, sidx, hidx, pos2].set(k)
+                kv = kv.at[li, 1, sidx, hidx, pos2].set(v)
+                x = block_decode_finish(lp, x, q, kv[li, 0], kv[li, 1],
+                                        positions)
+            xf = layer_norm(x, params["lnf_g"], params["lnf_b"])
+            logits = lm_logits(xf, params["tok_emb"])
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return kv, nxt
+
+        return jax.jit(decode_fn, donate_argnums=(1,))
+
+    def _build_prefill(self, bucket_len: int, trace_key: str):
+        """Compile one prompt bucket: window-parallel causal forward,
+        K/V pages parked into the target slot (slot and true length
+        are traced scalars — no recompile per slot), last real
+        position's greedy token returned. Pad rows beyond `length`
+        write pages the decode-side length masks never expose; they
+        are overwritten position-by-position as decoding advances."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nn.attention import (
+            block_prefill,
+            layer_norm,
+            lm_logits,
+        )
+
+        model = self.model
+        n_heads = model.n_heads
+        cache = model._jit_cache
+
+        def prefill_fn(params, kv, tokens, length, slot):
+            cache.record_trace(trace_key)
+            x = (params["tok_emb"][tokens]
+                 + params["pos_emb"][:bucket_len])
+            for li, lp in enumerate(params["layers"]):
+                x, k, v = block_prefill(lp, x, n_heads)
+                # window K/V arrive [T, H, Dh]; one small authored
+                # swap to the cache's head-major [H, T, Dh] pages —
+                # window-sized, paid once per JOIN (the big per-step
+                # cache tensors never transpose)
+                kt = jnp.swapaxes(k, 0, 1)[None, None, None]
+                vt = jnp.swapaxes(v, 0, 1)[None, None, None]
+                kv = jax.lax.dynamic_update_slice(
+                    kv, kt, (li, 0, slot, 0, 0, 0))
+                kv = jax.lax.dynamic_update_slice(
+                    kv, vt, (li, 1, slot, 0, 0, 0))
+            xf = layer_norm(x, params["lnf_g"], params["lnf_b"])
+            xl = jax.lax.dynamic_index_in_dim(xf, length - 1, axis=0,
+                                              keepdims=False)
+            logits = lm_logits(xl, params["tok_emb"])
+            nxt = jnp.argmax(logits).astype(jnp.int32)
+            return kv, nxt
+
+        return jax.jit(prefill_fn, donate_argnums=(1,))
+
+    # ----------------------------------------------------------- run
+    def step(self, kv, tokens, positions):
+        """One decode step over all slots. `tokens`/`positions` are
+        host [max_slots] int arrays (the engine's slot table); returns
+        (new_kv, next_tokens) with `kv` donated — the caller MUST
+        rebind. Inactive slots compute harmlessly (their writes land
+        on pages the masks keep dead until a prefill reclaims them);
+        the host decides whose outputs are real."""
+        import jax.numpy as jnp
+
+        fn = self._decode_program()
+        return fn(self.model.params, kv,
+                  jnp.asarray(tokens, jnp.int32),
+                  jnp.asarray(positions, jnp.int32))
+
+    def prefill(self, kv, prompt: Sequence[int], slot: int):
+        """Fill `slot`'s KV pages from a prompt and return
+        (new_kv, first_generated_token). Pads the prompt to its pow2
+        page-aligned bucket; `kv` is donated — rebind."""
+        import jax.numpy as jnp
+
+        prompt = np.asarray(prompt, np.int32).ravel()
+        b = self.bucket(len(prompt))
+        padded = np.zeros(b, np.int32)
+        padded[:len(prompt)] = prompt
+        fn = self._prefill_program(b)
+        return fn(self.model.params, kv, jnp.asarray(padded),
+                  jnp.int32(len(prompt)), jnp.int32(slot))
+
+    def warmup(self, kv, buckets: Sequence[int] = ()):
+        """Compile the decode step + the given prefill buckets up
+        front (serving warmup discipline: compiles happen before
+        traffic, the trace counters pin that none happen after).
+        Returns the (donated-through) cache buffer."""
+        for b in (buckets or (self.page_size,)):
+            kv, _ = self.prefill(kv, [0] * int(b), 0)
+        kv, _ = self.step(kv, np.zeros(self.max_slots, np.int32),
+                          np.zeros(self.max_slots, np.int32))
+        return kv
+
+    def trace_stats(self) -> dict:
+        cache = self.model._jit_cache
+        return {"trace_counts": cache.trace_counts(),
+                "total_traces": cache.total_traces(),
+                "compiles_total": cache.compiles_total(),
+                "compile_events": cache.compile_events()}
+
+    # ------------------------------------------------------------ lint
+    def lint_records(self, buckets: Sequence[int] = ()) -> List:
+        """ProgramRecords for the decode step and prefill bucket(s) —
+        built through the same cache paths `step`/`prefill` use (policy
+        registered), traced/lowered by the lint but never executed.
+        Donation on the [n_layers, 2, max_slots, max_ctx, ...] cache
+        is the declared fact prog-unhonored-donation verifies: a
+        silently-copied cache would double decode memory AND pay a
+        full-cache copy per token."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.analysis.program_lint import (
+            ProgramRecord,
+        )
+
+        model = self.model
+        kv = self.init_kv()
+        source = "deeplearning4j_tpu/engine/decode_program.py"
+        records = [ProgramRecord(
+            name=f"decode_step_s{self.max_slots}",
+            fn=getattr(self._decode_program(), "__wrapped__",
+                       self._decode_program()),
+            example_args=(model.params, kv,
+                          jnp.zeros(self.max_slots, jnp.int32),
+                          jnp.zeros(self.max_slots, jnp.int32)),
+            precision_policy=self.precision_policy, source=source,
+            consumed_outputs=(0, 1))]
+        for b in (buckets or (self.page_size,)):
+            b = int(b)
+            fn = self._prefill_program(b)
+            records.append(ProgramRecord(
+                name=f"decode_prefill_b{b}",
+                fn=getattr(fn, "__wrapped__", fn),
+                example_args=(model.params, kv,
+                              jnp.zeros(b, jnp.int32), jnp.int32(b),
+                              jnp.int32(0)),
+                precision_policy=self.precision_policy, source=source,
+                consumed_outputs=(0, 1)))
+        return records
+
+    # ------------------------------------------------------------ perf
+    def register_perf(self, cost_model, bucket_len: Optional[int] = None):
+        """Attach XLA cost-model entries for the decode step (and a
+        prefill bucket when given) to `cost_model` — MFU gauges +
+        forensics cost digests, the StepProgram.register_perf
+        discipline. Best-effort: returns the decode entry or None."""
+        import jax.numpy as jnp
+
+        cache = self.model._jit_cache
+        kv = self.init_kv()
+        entry = cost_model.register_jit_entry(
+            cache, self.decode_key(), self.model.params, kv,
+            jnp.zeros(self.max_slots, jnp.int32),
+            jnp.zeros(self.max_slots, jnp.int32))
+        if bucket_len:
+            b = int(bucket_len)
+            self._prefill_program(b)
+            cost_model.register_jit_entry(
+                cache, self.prefill_key(b), self.model.params,
+                self.init_kv(), jnp.zeros(b, jnp.int32), jnp.int32(b),
+                jnp.int32(0))
+        return entry

@@ -1,0 +1,167 @@
+"""KV-aware causal self-attention primitives.
+
+The decode-serving arc (ROADMAP item 3a) needs a transformer forward
+that exists in TWO compiled shapes over ONE set of weights:
+
+  prefill    a whole prompt window [T, d_model] processed in parallel
+             under a causal mask, emitting the window's K/V tensors so
+             the caller can park them in a slot's KV-cache pages;
+  decode     ONE new position per slot, batched over the engine's
+             [max_slots] axis, attending against the preallocated
+             per-slot cache with a per-slot length mask — the shape
+             that lets thousands of streams share one compiled step.
+
+Both build from the same per-layer parameter dict (see
+zoo/decoder.CausalTransformer), so the math of a position is defined
+once; engine/decode_program.py owns where K/V land in the cache.
+
+Layout discipline (Tensor Processing Primitives, arXiv 2104.05755):
+head_dim rides innermost everywhere (the contraction axis of both
+attention matmuls stays in the minor/lane dimension), and the DECODE
+cache is head-major [slots, n_heads, max_ctx, head_dim] so (slot,
+head) are leading batch dims of both cache contractions — XLA
+contracts in place instead of materializing a transposed cache copy
+per step (the transpose-churn finding the program lint raised against
+the first slot-major layout — PERF.md "Decode program layout").
+Masking uses a large finite negative instead of -inf so never-written
+cache positions (whatever bytes they hold) can't poison a softmax
+with inf-inf=NaN.
+
+Everything here is pure jax on traced values — no host syncs, no
+Python branching on data — so the functions compose into donated,
+compile-once programs.
+"""
+
+from __future__ import annotations
+
+# large finite "masked" score: exp(x - max) underflows to exactly 0.0
+# for masked lanes while never producing inf/NaN arithmetic
+MASK_VALUE = -1e30
+
+
+def layer_norm(x, gain, bias, eps: float = 1e-5):
+    """LayerNorm over the trailing (feature) axis."""
+    import jax.numpy as jnp
+
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + eps) * gain + bias
+
+
+def qkv_heads(lp: dict, x, n_heads: int):
+    """Project hidden states to per-head q/k/v: [..., d_model] ->
+    three [..., n_heads, head_dim] tensors (head_dim innermost)."""
+    import jax.numpy as jnp
+
+    def split(w):
+        y = x @ w
+        return jnp.reshape(y, y.shape[:-1] + (n_heads, -1))
+
+    return split(lp["wq"]), split(lp["wk"]), split(lp["wv"])
+
+
+def causal_window_attention(q, k, v):
+    """Full-window causal attention (the PREFILL shape): q/k/v are
+    [T, n_heads, head_dim]; position t attends to positions <= t of
+    the same window. Returns [T, n_heads, head_dim]."""
+    import jax.numpy as jnp
+
+    t = q.shape[0]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], q.dtype))
+    scores = jnp.einsum("thd,uhd->htu", q, k) * scale     # [H, T, T]
+    causal = jnp.tril(jnp.ones((t, t), bool))
+    scores = jnp.where(causal[None, :, :], scores, MASK_VALUE)
+    w = _softmax(scores)
+    return jnp.einsum("htu,uhd->thd", w, v)
+
+
+def cached_decode_attention(q, k_cache, v_cache, positions):
+    """Single-position attention against the slot cache (the DECODE
+    shape): `q` is [S, n_heads, head_dim] (one new position per slot),
+    `k_cache`/`v_cache` are HEAD-MAJOR [S, n_heads, max_ctx, head_dim]
+    with the new position's K/V already written at index
+    `positions[s]`, and each slot attends to its own cache entries
+    0..positions[s] — the per-slot length mask that makes slot
+    join/leave a pure data change, never a shape change. Head-major
+    cache layout is load-bearing: BOTH contractions below run with
+    (slot, head) as leading batch dims and the contraction axis minor,
+    so XLA never materializes a transposed copy of the cache (the 40%
+    transpose-churn the program lint flagged on the first slot-major
+    attempt — PERF.md). Returns [S, n_heads, head_dim]."""
+    import jax.numpy as jnp
+
+    c = k_cache.shape[2]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], q.dtype))
+    scores = jnp.einsum("shd,shcd->shc", q, k_cache) * scale
+    live = jnp.arange(c)[None, :] <= positions[:, None]   # [S, C]
+    scores = jnp.where(live[:, None, :], scores, MASK_VALUE)
+    w = _softmax(scores)
+    return jnp.einsum("shc,shcd->shd", w, v_cache)
+
+
+def _softmax(scores):
+    import jax.numpy as jnp
+
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    e = jnp.exp(scores - m)
+    return e / jnp.sum(e, axis=-1, keepdims=True)
+
+
+def mlp_block(lp: dict, x):
+    """The position-wise feed-forward half of a decoder block (GELU)."""
+    import jax
+
+    h = jax.nn.gelu(x @ lp["w1"] + lp["b1"], approximate=True)
+    return h @ lp["w2"] + lp["b2"]
+
+
+def block_prefill(lp: dict, x, n_heads: int):
+    """One decoder block over a whole window: x [T, d_model] ->
+    (x', k, v) where k/v are the window's cache-ready
+    [T, n_heads, head_dim] tensors (pre-attention projections of the
+    ln1 stream — exactly what the decode shape recomputes per
+    position, so a prefilled page and a decoded page hold the same
+    quantity)."""
+    h = layer_norm(x, lp["ln1_g"], lp["ln1_b"])
+    q, k, v = qkv_heads(lp, h, n_heads)
+    att = causal_window_attention(q, k, v)
+    x = x + _merge_heads(att) @ lp["wo"]
+    x = x + mlp_block(lp, layer_norm(x, lp["ln2_g"], lp["ln2_b"]))
+    return x, k, v
+
+
+def decode_qkv(lp: dict, x, n_heads: int):
+    """First half of a decode-shape block: the current position's
+    q/k/v projections off the ln1 stream — the same quantities
+    block_prefill parks in the cache, so a prefilled page and a
+    decoded page hold identical values. The caller writes k/v into
+    the slot's cache pages BEFORE calling `block_decode_finish` (the
+    position must attend to itself)."""
+    h = layer_norm(x, lp["ln1_g"], lp["ln1_b"])
+    return qkv_heads(lp, h, n_heads)
+
+
+def block_decode_finish(lp: dict, x, q, k_cache, v_cache, positions):
+    """Second half of a decode-shape block: attend `q` [S, H, Dh]
+    against the slot caches [S, max_ctx, H, Dh] (current position's
+    K/V already written at `positions[s]`) and run the residual +
+    feed-forward tail. Returns x' [S, d_model]."""
+    att = cached_decode_attention(q, k_cache, v_cache, positions)
+    x = x + _merge_heads(att) @ lp["wo"]
+    x = x + mlp_block(lp, layer_norm(x, lp["ln2_g"], lp["ln2_b"]))
+    return x
+
+
+def _merge_heads(att):
+    import jax.numpy as jnp
+
+    return jnp.reshape(att, att.shape[:-2] + (-1,))
+
+
+def lm_logits(x, tok_emb):
+    """Tied LM head: [..., d_model] x [vocab, d_model] -> [..., vocab]
+    via a direct contraction over d_model — no authored `tok_emb.T`
+    materialization (dot_general contracts either operand side)."""
+    import jax.numpy as jnp
+
+    return jnp.einsum("...d,vd->...v", x, tok_emb)

@@ -99,6 +99,12 @@ REGISTERED_METRICS = frozenset({
     "dl4j_rollout_rollbacks_total",
     "dl4j_rollout_holddowns_total",
     "dl4j_rollout_detection_seconds",
+    # continuous-batching decode engine (serving/continuous.py)
+    "dl4j_decode_active_slots",
+    "dl4j_decode_tokens_total",
+    "dl4j_decode_tokens_per_s",
+    "dl4j_decode_prefill_seconds",
+    "dl4j_decode_slot_evictions_total",
     "dl4j_jit_traces_total",
     "dl4j_jit_compiles_total",
     # performance introspection (observability/perf.py)

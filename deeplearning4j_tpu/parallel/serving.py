@@ -28,6 +28,15 @@ server — every model × version has its own warmed ParallelInference):
                  body may carry {"tenant": ...} (or X-Tenant header)
                  for admission, and "inputs" may be a dict of named
                  input streams for multi-input graphs
+  POST   /v1/models/<name>/generate     continuous-batched
+                 autoregressive generation (serving/continuous.py
+                 DecodeEngine attached via `decode_engine=` /
+                 `attach_decode_engine`): {"prompt": [ids...],
+                 "max_new_tokens": n, "eos_id": id?} -> {"tokens":
+                 [...], "finish_reason": "eos"|"length"}. Speaks the
+                 npz wire too (prompt as an int array entry; the
+                 VARIABLE-LENGTH token output rides back as a raw
+                 int32 array). 429 + Retry-After on slot exhaustion
   GET    /v1/models                     catalog: every model, version,
                  lifecycle state, active/previous pointers
   GET    /v1/models/<name>/status       per-model pipeline/trace facts
@@ -207,7 +216,8 @@ class ModelServer:
                  max_wait_ms: float = 2.0, adaptive_wait: bool = True,
                  tracer=None, registry=None, admission=None,
                  tenants=None, model_name: str = "default",
-                 queue_limit: int = 64):
+                 queue_limit: int = 64, decode_engine=None,
+                 decode_engines=None):
         from deeplearning4j_tpu.serving.admission import (
             AdmissionController,
             TenantConfig,
@@ -233,6 +243,11 @@ class ModelServer:
                  for n, t in tenants.items()})
         else:
             self.admission = None
+        # continuous-batching decode engines, keyed by model name
+        # (serving/continuous.py — the /v1/models/<m>/generate route)
+        self.decode_engines = dict(decode_engines or {})
+        if decode_engine is not None:
+            self.decode_engines.setdefault(model_name, decode_engine)
         self.tracer = tracer if tracer is not None \
             else getattr(self._default_pi(), "tracer", None)
         self.labels = labels
@@ -243,6 +258,7 @@ class ModelServer:
         self._served = 0
         self._served_lock = threading.Lock()
         self._ready = False
+        self._started_engines = set()
         self._t0 = time.monotonic()
 
     # --------------------------------------------------------- plumbing
@@ -348,6 +364,57 @@ class ModelServer:
                 for row in self.labels.decode_predictions(out, top=top)]
         return resp
 
+    # --------------------------------------------------------- generate
+    def attach_decode_engine(self, name: str, engine) -> "ModelServer":
+        """Attach a continuous-batching DecodeEngine to model `name`
+        (the /v1/models/<name>/generate route)."""
+        self.decode_engines[name] = engine
+        return self
+
+    def _handle_generate(self, req: dict, model: Optional[str],
+                         tenant: Optional[str] = None) -> dict:
+        name = model or self.registry.default_model
+        engine = self.decode_engines.get(name)
+        if engine is None:
+            raise ModelNotFoundError(
+                f"model {name!r} has no decode engine attached")
+        # npz wire reuses the generic 'inputs' array entry as the
+        # prompt; JSON spells it 'prompt'
+        prompt = req.get("prompt", req.get("inputs"))
+        if prompt is None:
+            raise _ClientError("missing required field 'prompt'")
+        try:
+            prompt = [int(t) for t in np.asarray(prompt).ravel()]
+        except (TypeError, ValueError) as e:
+            raise _ClientError(f"bad 'prompt': {e}") from None
+        try:
+            max_new = int(req.get("max_new_tokens", 16))
+            eos_id = req.get("eos_id")
+            eos_id = None if eos_id is None else int(eos_id)
+            timeout_s = float(req.get("timeout_s", 60.0))
+        except (TypeError, ValueError) as e:
+            raise _ClientError(f"bad generate parameters: {e}") \
+                from None
+        tenant = tenant or req.get("tenant")
+        if not engine.running:
+            # lazily start the decode loop; stop() tears down only
+            # loops this server started (caller-owned engines keep
+            # running — the caller-owned ParallelInference rule)
+            engine.ensure_started()
+            self._started_engines.add(name)
+        try:
+            handle = engine.generate(prompt, max_new, eos_id=eos_id,
+                                     tenant=tenant,
+                                     timeout_s=timeout_s)
+        except ValueError as e:
+            raise _ClientError(str(e)) from None
+        return {
+            "tokens": handle.tokens_so_far(),
+            "model": name,
+            "finish_reason": handle.finish_reason,
+            "evictions": handle.evictions,
+        }
+
     # ------------------------------------------------- lifecycle routes
     def _handle_put_version(self, model: str, version: str,
                             req: dict) -> dict:
@@ -414,6 +481,12 @@ class ModelServer:
             }
         if self.admission is not None:
             facts["admission"] = self.admission.stats()
+        # continuous-batching decode engines: slot occupancy, token
+        # throughput, eviction/prefill counters, compile-trace pins
+        if self.decode_engines:
+            facts["decode"] = {name: engine.stats()
+                               for name, engine
+                               in self.decode_engines.items()}
         # telemetry facts (observability/): uptime + the registry's
         # monotonic request/error counters (process-wide, survive
         # across this server's construction), plus span-buffer facts
@@ -610,6 +683,35 @@ class ModelServer:
 
                 self._guarded(_run)
 
+            def _generate(self, model):
+                _obs.count("dl4j_serving_requests_total")
+                t0 = time.perf_counter()
+
+                def _run():
+                    _fire("serve.request")
+                    binary = NPZ_CONTENT_TYPE in (
+                        self.headers.get("Content-Type") or "")
+                    req = (decode_npz_request(self._read_raw())
+                           if binary else self._read_body())
+                    resp = server._handle_generate(
+                        req, model=model,
+                        tenant=self.headers.get("X-Tenant"))
+                    _obs.observe("dl4j_serving_request_seconds",
+                                 time.perf_counter() - t0)
+                    if binary:
+                        # the VARIABLE-LENGTH token output rides as a
+                        # raw int32 array entry, length set by this
+                        # request's generation alone
+                        tokens = np.asarray(resp.pop("tokens"),
+                                            np.int32)
+                        self._send_bytes(
+                            200, encode_npz_response(tokens, resp),
+                            NPZ_CONTENT_TYPE)
+                    else:
+                        self._send(200, resp)
+
+                self._guarded(_run)
+
             def do_POST(self):
                 path = self.path.rstrip("/")
                 route = self._model_route(path)
@@ -617,6 +719,8 @@ class ModelServer:
                     self._predict(None)
                 elif route is not None and route[1] == "predict":
                     self._predict(route[0])
+                elif route is not None and route[1] == "generate":
+                    self._generate(route[0])
                 elif route is not None and route[1] in ("rollback",
                                                         "swap"):
                     name, cmd, _ = route
@@ -683,6 +787,13 @@ class ModelServer:
         if self._thread is not None:
             self._thread.join(timeout=2.0)
             self._thread = None
+        # stop only the decode-engine loops THIS server started —
+        # caller-started engines keep running (the PI ownership rule)
+        for name in sorted(self._started_engines):
+            engine = self.decode_engines.get(name)
+            if engine is not None:
+                engine.stop()
+        self._started_engines.clear()
         if self._owns_registry:
             # the registry shuts down only the ParallelInference
             # front-ends it built — never a caller-supplied one
@@ -881,6 +992,49 @@ class ModelClient:
         else:
             payload = {
                 "inputs": np.asarray(inputs).tolist()}   # analyze: allow=jit-host-sync — legacy JSON wire fallback, host-side data
+        payload.update(meta)
+        return self._request(route, payload)
+
+    def generate(self, prompt, max_new_tokens: int = 16,
+                 eos_id: Optional[int] = None,
+                 model: Optional[str] = None,
+                 tenant: Optional[str] = None,
+                 timeout_s: Optional[float] = None) -> dict:
+        """POST /v1/models/<model>/generate — continuous-batched
+        autoregressive generation. Returns {"tokens": [int, ...],
+        "finish_reason": "eos"|"length", ...}; the token list length
+        varies per request (eos can cut it short). Binary npz wire by
+        default: the prompt ships as a raw int array and the
+        variable-length output comes back as one — same
+        fall-back-to-JSON discipline as `predict`. Slot exhaustion
+        surfaces as a 429 ServingError with Retry-After."""
+        model = model or "default"
+        route = f"/v1/models/{model}/generate"
+        meta = {"max_new_tokens": int(max_new_tokens)}
+        if eos_id is not None:
+            meta["eos_id"] = int(eos_id)
+        if tenant is not None:
+            meta["tenant"] = tenant
+        if timeout_s is not None:
+            meta["timeout_s"] = float(timeout_s)
+        if self._npz_ok:
+            try:
+                resp = self._request_bytes(
+                    route,
+                    encode_npz_request(
+                        np.asarray(prompt, np.int32), meta),
+                    NPZ_CONTENT_TYPE)
+                out = resp.pop("outputs", None)
+                if out is not None and "tokens" not in resp:
+                    resp["tokens"] = [int(t) for t in
+                                      np.asarray(out).ravel()]
+                return resp
+            except ServingError as e:
+                if self.wire == "npz" or not self._old_server_error(e):
+                    raise
+                self._npz_ok = False   # old server: JSON from here on
+        payload = {"prompt": [int(t) for t in
+                              np.asarray(prompt).ravel()]}
         payload.update(meta)
         return self._request(route, payload)
 

@@ -62,6 +62,14 @@ Fault points wired through the stack:
                      without a real process kill): the controller
                      removes it from the router and backfills from the
                      replica factory
+  serving.slot_evict  DecodeEngine.step_once, once per engine
+                     iteration — `raise` is consumed as a forced
+                     mid-generation slot eviction: the lowest-indexed
+                     active generation stream is ripped out of its
+                     slot and re-queued for re-prefill + forced replay
+                     on a free slot, with output byte-identical to a
+                     never-evicted run (the continuous-batching
+                     recovery drill)
   admission.quota_storm  AdmissionController.admit, once per decision —
                      `raise` is consumed as a forced quota shed for
                      METERED tenants (unmetered/high classes are
@@ -113,6 +121,7 @@ REGISTERED_POINTS = frozenset({
     "rollout.canary_poison",
     "serve.request",
     "serving.replica_kill",
+    "serving.slot_evict",
     "train.grad_nonfinite",
     "train.hang",
     "train.hang_hard",

@@ -36,6 +36,11 @@ from deeplearning4j_tpu.serving.registry import (  # noqa: F401
     ModelRegistry,
 )
 from deeplearning4j_tpu.serving.router import ReplicaRouter  # noqa: F401
+from deeplearning4j_tpu.serving.continuous import (  # noqa: F401
+    DecodeEngine,
+    GenerationHandle,
+    sequential_decode,
+)
 from deeplearning4j_tpu.serving.controller import (  # noqa: F401
     ROLLOUT_STATES,
     FleetController,
@@ -51,4 +56,5 @@ __all__ = [
     "ModelEntry", "ModelRegistry", "ReplicaRouter",
     "FleetController", "HttpReplica", "LocalReplica", "SLOPolicy",
     "slo_sample",
+    "DecodeEngine", "GenerationHandle", "sequential_decode",
 ]

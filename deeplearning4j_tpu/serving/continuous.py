@@ -1,0 +1,502 @@
+"""Continuous batching: the slot-based autoregressive decode engine.
+
+ROADMAP item 3a — THE serving regime for autoregressive traffic at
+"millions of users" scale. The fixed-shape request pipeline
+(ParallelInference) coalesces one-shot requests into pow2 buckets;
+generation is different: a request is ALIVE for many steps, and naive
+per-request serving pays a full program dispatch per token for ONE
+stream. The DecodeEngine instead runs ONE compiled decode step over a
+fixed `max_slots` batch (engine/decode_program.DecodeProgram) and
+treats request lifecycle as pure data:
+
+  join    an admitted request claims a free slot at ANY step: one
+          bucketed prefill dispatch parks its prompt's K/V pages and
+          yields its first token, then the slot rides the shared
+          decode loop — running streams never wait out a long prompt
+          token-by-token, and nothing recompiles;
+  leave   EOS or max-tokens frees the slot between two steps; the
+          program never learns a request ended (per-slot active masks
+          are host state — the compiled shape is invariant);
+  evict   the `serving.slot_evict` fault point (chaos drills) can rip
+          an active request out mid-generation: its recovery is
+          re-prefill of the ORIGINAL prompt on a free slot + forced
+          replay of the already-emitted tokens through the shared
+          decode loop. Replay recomputes the exact K/V the evicted
+          slot held (same programs, same inputs), so the continuation
+          is byte-identical to a never-evicted run — the property
+          `sequential_decode` oracles pin.
+
+Byte-identity contract: greedy decoding + per-slot independence of the
+compiled step mean every emitted token is a deterministic function of
+the request's own tokens — independent of which slot it lands in, who
+its neighbors are, and when it joins. tests/test_decode.py pins
+engine output == sequential per-request oracle under staggered churn
+AND mid-soak eviction chaos.
+
+Admission rides the same vocabulary as the fixed-shape plane: an
+optional AdmissionController (tenant quotas / priority shed) in front,
+and a hard capacity bound (`max_slots` resident + `queue_limit`
+waiting) that rejects with QuotaExceededError -> HTTP 429 +
+Retry-After on slot exhaustion.
+
+Per-token accumulation is streaming-capable: tokens land in the
+handle under a condition variable as they are emitted
+(`tokens_so_far()` / `wait_for_tokens(n)`), so a streaming transport
+can drain mid-generation; `result()` blocks for the final sequence.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.observability import metrics as _obs
+from deeplearning4j_tpu.resilience.errors import (
+    FaultInjectedError,
+    QuotaExceededError,
+    ShutdownError,
+)
+from deeplearning4j_tpu.resilience.faults import fire as _fire
+
+
+class GenerationHandle:
+    """One generation stream: prompt in, tokens accumulating out.
+
+    Thread-safe: the engine loop appends, any number of consumers
+    read. `finish_reason` is "eos" (the eos token was emitted — it IS
+    included in the output) or "length" (max_new_tokens reached)."""
+
+    def __init__(self, prompt: List[int], max_new_tokens: int,
+                 eos_id: Optional[int]):
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.eos_id = eos_id
+        self.finish_reason: Optional[str] = None
+        self.evictions = 0
+        self._tokens: List[int] = []
+        self._cond = threading.Condition()
+        self._done = False
+        self._error: Optional[BaseException] = None
+
+    # ------------------------------------------------------- consumers
+    def tokens_so_far(self) -> List[int]:
+        with self._cond:
+            return list(self._tokens)
+
+    def wait_for_tokens(self, n: int, timeout_s: float = 30.0) -> List[int]:
+        """Block until at least `n` tokens exist (or the stream ends);
+        the streaming-transport primitive."""
+        with self._cond:
+            self._cond.wait_for(
+                lambda: self._done or len(self._tokens) >= n,
+                timeout=timeout_s)
+            return list(self._tokens)
+
+    @property
+    def done(self) -> bool:
+        with self._cond:
+            return self._done
+
+    def result(self, timeout_s: Optional[float] = 60.0) -> List[int]:
+        with self._cond:
+            if not self._cond.wait_for(lambda: self._done,
+                                       timeout=timeout_s):
+                raise TimeoutError(
+                    f"generation not finished within {timeout_s}s "
+                    f"({len(self._tokens)}/{self.max_new_tokens} tokens)")
+            if self._error is not None:
+                raise self._error
+            return list(self._tokens)
+
+    # ---------------------------------------------------- engine side
+    def _append(self, tok: int) -> None:
+        with self._cond:
+            self._tokens.append(tok)
+            self._cond.notify_all()
+
+    def _finish(self, reason: Optional[str],
+                error: Optional[BaseException] = None) -> None:
+        with self._cond:
+            self.finish_reason = reason
+            self._error = error
+            self._done = True
+            self._cond.notify_all()
+
+
+class DecodeEngine:
+    """Slot-based continuous-batching server for one decoder model.
+
+    `submit()` is non-blocking admission; a background loop (or
+    explicit `step_once()` calls — the deterministic-test drive)
+    advances every resident stream one token per compiled dispatch.
+    One DecodeProgram = one decode compile serves arbitrary join/leave
+    traffic; `stats()["trace_counts"]` is the pin."""
+
+    def __init__(self, model=None, max_slots: int = 8,
+                 page_size: int = 16, queue_limit: Optional[int] = None,
+                 admission=None, model_name: str = "decoder",
+                 program=None, max_prefills_per_step: int = 1):
+        from deeplearning4j_tpu.engine.decode_program import (
+            DecodeProgram,
+        )
+
+        if program is None:
+            if model is None:
+                raise ValueError("DecodeEngine needs a model or a "
+                                 "DecodeProgram")
+            program = DecodeProgram(model, max_slots=max_slots,
+                                    page_size=page_size)
+        self.program = program
+        self.max_slots = program.max_slots
+        self.admission = admission
+        self.model_name = model_name
+        self.queue_limit = (int(queue_limit) if queue_limit is not None
+                            else 2 * self.max_slots)
+        # a join costs one prefill dispatch between decode steps; cap
+        # how many joins one step pays for so an admission burst can't
+        # stall resident streams (the prefill-vs-decode phase split)
+        self.max_prefills_per_step = max(1, int(max_prefills_per_step))
+        self.kv = program.init_kv()
+        s = self.max_slots
+        self._tokens = np.zeros(s, np.int32)
+        self._positions = np.zeros(s, np.int32)
+        self._active = np.zeros(s, bool)
+        self._slot_req: List[Optional[GenerationHandle]] = [None] * s
+        self._slot_replay: List[Optional[deque]] = [None] * s
+        # pending entries: (handle, replay_tokens or None)
+        self._pending: deque = deque()
+        # requests popped from pending but not yet resident (prefill
+        # in flight) — still counted against capacity, so admission
+        # can't oversubscribe through the placement window
+        self._placing = 0
+        self._cond = threading.Condition()
+        self._step_lock = threading.Lock()
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._t0 = time.monotonic()
+        self._tokens_emitted = 0
+        self._steps = 0
+        self._prefills = 0
+        self._evictions = 0
+        self._completed = 0
+
+    # -------------------------------------------------------- lifecycle
+    def start(self) -> "DecodeEngine":
+        with self._cond:
+            if self._running:
+                return self
+            self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="DecodeEngine-loop")
+        self._thread.start()
+        return self
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def ensure_started(self) -> "DecodeEngine":
+        if not self.running:
+            return self.start()
+        return self
+
+    def stop(self) -> None:
+        with self._cond:
+            self._running = False
+            pending = list(self._pending)
+            self._pending.clear()
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        # fail whatever never reached a slot; resident streams keep
+        # their partial output readable (tokens_so_far) but never
+        # finish — mark them failed too so result() callers unblock
+        err = ShutdownError("decode engine stopped")
+        for handle, _ in pending:
+            handle._finish(None, error=err)
+        for s in range(self.max_slots):
+            if self._active[s] and self._slot_req[s] is not None:
+                self._slot_req[s]._finish(None, error=err)
+                self._free_slot(s)
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                if not self._running:
+                    return
+            worked = self.step_once()
+            if not worked:
+                with self._cond:
+                    if self._running:
+                        self._cond.wait(timeout=0.02)
+
+    # -------------------------------------------------------- admission
+    def submit(self, prompt: Sequence[int], max_new_tokens: int,
+               eos_id: Optional[int] = None,
+               tenant: Optional[str] = None) -> GenerationHandle:
+        """Admit one generation request (non-blocking). Raises
+        QuotaExceededError (HTTP 429 + Retry-After) on tenant quota /
+        priority shed (AdmissionController) or on slot exhaustion —
+        every slot resident and the wait queue full."""
+        prompt = [int(t) for t in np.asarray(prompt, np.int64).ravel()]
+        if not prompt:
+            raise ValueError("prompt must carry at least one token")
+        max_new_tokens = int(max_new_tokens)
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(prompt) + max_new_tokens > self.program.model.max_ctx:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_ctx "
+                f"{self.program.model.max_ctx}")
+        capacity = self.max_slots + self.queue_limit
+        depth = self._in_flight()
+        if self.admission is not None:
+            self.admission.admit(tenant, self.model_name, depth,
+                                 capacity)
+        handle = GenerationHandle(prompt, max_new_tokens, eos_id)
+        with self._cond:
+            if (int(self._active.sum()) + len(self._pending)
+                    + self._placing) >= capacity:
+                shed = True
+            else:
+                shed = False
+                self._pending.append((handle, None))
+                self._cond.notify_all()
+        if shed:
+            raise QuotaExceededError(
+                f"decode slots exhausted ({self.max_slots} resident, "
+                f"{self.queue_limit} waiting)", tenant=tenant or "",
+                retry_after_s=0.5)
+        return handle
+
+    def generate(self, prompt: Sequence[int], max_new_tokens: int,
+                 eos_id: Optional[int] = None,
+                 tenant: Optional[str] = None,
+                 timeout_s: float = 60.0) -> GenerationHandle:
+        """submit + wait: returns the FINISHED handle (tokens via
+        `.tokens_so_far()` / `.result()`)."""
+        handle = self.submit(prompt, max_new_tokens, eos_id=eos_id,
+                             tenant=tenant)
+        handle.result(timeout_s=timeout_s)
+        return handle
+
+    def _in_flight(self) -> int:
+        with self._cond:
+            return (int(self._active.sum()) + len(self._pending)
+                    + self._placing)
+
+    # ------------------------------------------------------------- step
+    def step_once(self) -> bool:
+        """One engine iteration: chaos check, admit waiting requests to
+        free slots (bounded prefills), one shared decode dispatch,
+        harvest. Returns False when there was nothing to do. Public so
+        tests drive churn deterministically without the loop thread.
+        Telemetry (fault point, counters, gauges) fires OUTSIDE the
+        step lock — emission is never a blocking op under a lock."""
+        try:
+            _fire("serving.slot_evict")
+            evict = False
+        except FaultInjectedError:
+            evict = True
+        prefill_s: List[float] = []
+        with self._step_lock:
+            evicted = self._evict_lowest_active() if evict else 0
+            admitted, emitted = self._admit_pending(prefill_s)
+            stepped = bool(self._active.any())
+            if stepped:
+                self.kv, nxt = self.program.step(self.kv, self._tokens,
+                                                 self._positions)
+                nxt_host = np.asarray(nxt)
+                self._steps += 1
+                emitted += self._harvest(nxt_host)
+        if evicted:
+            _obs.count("dl4j_decode_slot_evictions_total", n=evicted)
+        for dt in prefill_s:
+            _obs.observe("dl4j_decode_prefill_seconds", dt)
+        if emitted:
+            _obs.count("dl4j_decode_tokens_total", n=emitted)
+        self._publish_gauges()
+        return stepped or admitted
+
+    def _admit_pending(self, prefill_s: List[float]):
+        admitted = False
+        emitted = 0
+        for _ in range(self.max_prefills_per_step):
+            free = [s for s in range(self.max_slots)
+                    if not self._active[s]]
+            if not free:
+                break
+            with self._cond:
+                if not self._pending:
+                    break
+                handle, replay = self._pending.popleft()
+                self._placing += 1
+            try:
+                emitted += self._place(handle, replay, free[0],
+                                       prefill_s)
+            finally:
+                with self._cond:
+                    self._placing -= 1
+            admitted = True
+        return admitted, emitted
+
+    def _place(self, handle: GenerationHandle,
+               replay: Optional[List[int]], slot: int,
+               prefill_s: List[float]) -> int:
+        """Prefill `handle`'s prompt into `slot` and make it resident.
+        `replay` (eviction recovery) carries the already-emitted
+        tokens: the re-prefill regenerates the first one (same
+        bucketed program, same prompt — bitwise the same token) and
+        the rest are force-fed through the decode loop instead of
+        re-emitted, so the stream's output is unaffected by the
+        eviction. Returns how many tokens were emitted (0 or 1)."""
+        t0 = time.perf_counter()
+        self.kv, first_dev = self.program.prefill(self.kv,
+                                                  handle.prompt, slot)
+        first = int(np.asarray(first_dev))
+        self._prefills += 1
+        prefill_s.append(time.perf_counter() - t0)
+        self._positions[slot] = len(handle.prompt)
+        self._slot_req[slot] = handle
+        self._active[slot] = True
+        if replay:
+            # forced replay: the recorded token stream IS the truth
+            # (greedy decode would regenerate it; forcing makes the
+            # recovery independent of it)
+            self._tokens[slot] = replay[0]
+            self._slot_replay[slot] = deque(replay[1:]) or None
+            return 0
+        self._slot_replay[slot] = None
+        self._tokens[slot] = first
+        handle._append(first)
+        self._tokens_emitted += 1
+        self._maybe_finish(slot, first)
+        return 1
+
+    def _harvest(self, nxt_host: np.ndarray) -> int:
+        emitted = 0
+        for s in range(self.max_slots):
+            if not self._active[s]:
+                continue
+            self._positions[s] += 1
+            replay = self._slot_replay[s]
+            if replay is not None:
+                forced = replay.popleft()
+                if not replay:
+                    self._slot_replay[s] = None
+                self._tokens[s] = forced
+                continue
+            tok = int(nxt_host[s])
+            self._tokens[s] = tok
+            handle = self._slot_req[s]
+            handle._append(tok)
+            emitted += 1
+            self._tokens_emitted += 1
+            self._maybe_finish(s, tok)
+        return emitted
+
+    def _maybe_finish(self, slot: int, tok: int) -> None:
+        handle = self._slot_req[slot]
+        if handle.eos_id is not None and tok == handle.eos_id:
+            handle._finish("eos")
+        elif len(handle.tokens_so_far()) >= handle.max_new_tokens:
+            handle._finish("length")
+        else:
+            return
+        self._free_slot(slot)
+        self._completed += 1
+
+    def _free_slot(self, slot: int) -> None:
+        self._active[slot] = False
+        self._slot_req[slot] = None
+        self._slot_replay[slot] = None
+        self._positions[slot] = 0
+        self._tokens[slot] = 0
+
+    # --------------------------------------------------------- eviction
+    def _evict_lowest_active(self) -> int:
+        """Forced mid-generation eviction (the serving.slot_evict
+        drill): rip the lowest-indexed active request out of its slot
+        and queue it — FRONT of the line — for re-prefill + replay on
+        the next free slot. Replay-in-progress streams requeue with
+        their full recorded output; nothing is emitted twice. Returns
+        the eviction count (the caller emits the metric outside the
+        step lock)."""
+        victims = [s for s in range(self.max_slots) if self._active[s]]
+        if not victims:
+            return 0
+        s = victims[0]
+        handle = self._slot_req[s]
+        recorded = handle.tokens_so_far()
+        self._free_slot(s)
+        handle.evictions += 1
+        self._evictions += 1
+        with self._cond:
+            self._pending.appendleft((handle, recorded))
+            self._cond.notify_all()
+        return 1
+
+    # ------------------------------------------------------------ stats
+    def _publish_gauges(self) -> None:
+        active = int(self._active.sum())
+        _obs.set_gauge("dl4j_decode_active_slots", active)
+        elapsed = max(time.monotonic() - self._t0, 1e-9)
+        _obs.set_gauge("dl4j_decode_tokens_per_s",
+                       self._tokens_emitted / elapsed)
+
+    def tokens_per_s(self) -> float:
+        return self._tokens_emitted / max(time.monotonic() - self._t0,
+                                          1e-9)
+
+    def stats(self) -> Dict:
+        with self._cond:
+            pending = len(self._pending)
+        return {
+            "model": self.model_name,
+            "max_slots": self.max_slots,
+            "active_slots": int(self._active.sum()),
+            "pending": pending,
+            "queue_limit": self.queue_limit,
+            "page_size": self.program.page_size,
+            "max_ctx": self.program.model.max_ctx,
+            "steps": self._steps,
+            "prefills": self._prefills,
+            "tokens_total": self._tokens_emitted,
+            "completed": self._completed,
+            "evictions": self._evictions,
+            "tokens_per_s": round(self.tokens_per_s(), 3),
+            "trace_counts": self.program.trace_stats()["trace_counts"],
+        }
+
+
+def sequential_decode(program, prompt: Sequence[int],
+                      max_new_tokens: int,
+                      eos_id: Optional[int] = None, kv=None,
+                      slot: int = 0):
+    """The per-request ORACLE: prefill + one-stream decode on the same
+    compiled programs the engine runs, one request at a time. Returns
+    (kv, tokens). Continuous-batched output must equal this bitwise
+    for every request regardless of slot churn — the correctness bar
+    that makes slot join/leave (and eviction replay) trustworthy."""
+    if kv is None:
+        kv = program.init_kv()
+    tokens = np.zeros(program.max_slots, np.int32)
+    positions = np.zeros(program.max_slots, np.int32)
+    kv, first = program.prefill(kv, prompt, slot)
+    out = [int(np.asarray(first))]
+    tokens[slot] = out[0]
+    positions[slot] = len(list(prompt))
+    while len(out) < max_new_tokens and (eos_id is None
+                                         or out[-1] != eos_id):
+        kv, nxt = program.step(kv, tokens, positions)
+        positions[slot] += 1
+        tok = int(np.asarray(nxt)[slot])
+        out.append(tok)
+        tokens[slot] = tok
+    return kv, out

@@ -417,6 +417,19 @@ def telemetry_lines(snapshot) -> list:
         if occ and occ.get("p50") is not None:
             serv.append(f"occupancy p50 {occ['p50']:g}")
         lines.append("serving — " + " · ".join(serv))
+    # continuous-batching decode engine (serving/continuous.py):
+    # resident generation streams, token throughput, chaos evictions
+    decode_slots = gauge("dl4j_decode_active_slots")
+    if decode_slots is not None or "dl4j_decode_tokens_total" in c:
+        dec = [f"{int(decode_slots or 0)} slots"]
+        rate = gauge("dl4j_decode_tokens_per_s")
+        if rate is not None:
+            dec.append(f"{rate:.1f} tok/s")
+        dec.append(f"{c.get('dl4j_decode_tokens_total', 0)} tokens")
+        if "dl4j_decode_slot_evictions_total" in c:
+            dec.append(f"{c['dl4j_decode_slot_evictions_total']} "
+                       "evictions")
+        lines.append("decode — " + " · ".join(dec))
     # performance introspection (observability/perf.py): cost-model
     # MFU gauge, top phases by attributed share, recompile count
     perf = []

@@ -7,6 +7,7 @@ All conv models are NHWC + bfloat16-friendly (MXU-aligned channel counts
 where the original architecture allows)."""
 
 from deeplearning4j_tpu.zoo.base import ZooModel, ModelSelector, ZooType  # noqa: F401
+from deeplearning4j_tpu.zoo.decoder import CausalTransformer  # noqa: F401
 from deeplearning4j_tpu.zoo.models import (  # noqa: F401
     AlexNet,
     FaceNetNN4Small2,
