@@ -51,6 +51,24 @@ Modes:
       any rate is reported. Writes BENCH_decode_off.json /
       BENCH_decode_on.json on decode_tokens_per_sec, gated by
       `python tools/perf_gate.py --metric decode`.
+  python bench_serving.py decode_chaos [n_requests]
+      generation-durability chaos A/B (PR 16): the same mixed request
+      set through a 3-replica decode fleet (ReplicaRouter +
+      FleetController, shared compiled programs) twice. Control arm:
+      no chaos. Chaos arm, mid-generation: one replica HARD-killed
+      (streams restart from their prompts), a second gracefully
+      retired (streams migrate as resumable `(prompt, tokens-so-far)`
+      continuations), a `decode.nonfinite` poison step (slot
+      quarantine + replay) and a `decode.hang` loop wedge (watchdog
+      teardown + bounded engine restart) — controller backfills
+      throughout. BOTH arms must finish every request bitwise equal
+      to the sequential oracle (zero lost) before a rate is reported;
+      headline is end-to-end goodput. Writes
+      BENCH_decode_chaos_off.json / BENCH_decode_chaos.json, gated by
+      `python tools/perf_gate.py --metric decode_chaos --tolerance
+      0.7` (the tolerance IS the durability-tax budget: the chaos arm
+      pays two 1.2s loop wedges, watchdog windows, replays, and a
+      backfill against a ~2.5s control run).
   python bench_serving.py soak [duration_s] [out.json]
       mixed-tenant multi-model control-plane soak: 2 real models × 3
       tenants with skewed priorities (gold=high, silver=normal,
@@ -1193,7 +1211,313 @@ def bench_decode(n_requests=64, max_slots=8, seed=0):
     return off_doc, on_doc
 
 
+# ------------------------------------------------- decode chaos soak
+def bench_decode_chaos(n_requests=64, max_slots=8, seed=0):
+    """Generation-durability chaos A/B (decode_chaos mode — story in
+    the module docstring). The SAME mixed request set is pushed through
+    a 3-replica decode fleet twice: the control arm runs undisturbed;
+    the chaos arm hard-kills one replica mid-generation, gracefully
+    retires a second (its in-flight streams migrate as resumable
+    continuations), poisons a decode step (`decode.nonfinite` → slot
+    quarantine + replay) and wedges a decode loop (`decode.hang` →
+    watchdog teardown + engine restart) — all while the
+    FleetController backfills. BOTH arms must complete every request
+    with token streams bitwise equal to the sequential oracle (zero
+    lost) before any rate is reported; the headline is end-to-end
+    goodput, so the gate bounds the durability tax."""
+    import queue as _queue
+    import random
+    import threading
+
+    from deeplearning4j_tpu.engine.decode_program import DecodeProgram
+    from deeplearning4j_tpu.observability.metrics import get_registry
+    from deeplearning4j_tpu.parallel.serving import (
+        ModelClient,
+        ModelServer,
+    )
+    from deeplearning4j_tpu.resilience.errors import (
+        NoHealthyReplicaError,
+    )
+    from deeplearning4j_tpu.resilience.faults import injector
+    from deeplearning4j_tpu.resilience.retry import Retry
+    from deeplearning4j_tpu.serving import (
+        FleetController,
+        HttpReplica,
+        ReplicaRouter,
+        SLOPolicy,
+    )
+    from deeplearning4j_tpu.serving.continuous import (
+        DecodeEngine,
+        sequential_decode,
+    )
+    from deeplearning4j_tpu.zoo.decoder import CausalTransformer
+
+    model = CausalTransformer(vocab_size=512, d_model=128, n_heads=8,
+                              n_layers=4, max_ctx=128, seed=7).init()
+    # ONE DecodeProgram (stateless between steps: KV threads through
+    # as an argument) shared by every replica — the compiled programs
+    # are paid for once, so the A/B measures durability, not compiles
+    prog = DecodeProgram(model, max_slots=max_slots, page_size=16)
+    rng = random.Random(seed)
+    reqs = [([rng.randrange(model.vocab_size)
+              for _ in range(rng.randrange(4, 33))],
+             rng.randrange(24, 65)) for _ in range(n_requests)]
+    buckets = sorted({prog.bucket(len(p)) for p, _ in reqs})
+    prog.warmup(prog.init_kv(), buckets=buckets)
+    oracle = []
+    kv = prog.init_kv()
+    for prompt, mx in reqs:
+        kv, toks = sequential_decode(prog, prompt, mx, kv=kv)
+        oracle.append(toks)
+    total_tokens = sum(len(t) for t in oracle)
+    reg = get_registry()
+    COUNTERS = ("dl4j_decode_slot_quarantines_total",
+                "dl4j_decode_migrations_total",
+                "dl4j_decode_replays_total",
+                "dl4j_decode_engine_restarts_total")
+
+    def run_arm(chaos):
+        injector().clear()
+        before = {k: reg.counter_value(k) for k in COUNTERS}
+        servers = []
+
+        def spawn():
+            eng = DecodeEngine(program=prog, watchdog_timeout_s=0.5,
+                               max_engine_restarts=4)
+            srv = ModelServer(port=0, decode_engine=eng,
+                              model_name="decoder").start()
+            servers.append(srv)
+            return srv
+
+        fleet = [spawn() for _ in range(3)]
+        urls = [f"http://127.0.0.1:{s.port}" for s in fleet]
+        router = ReplicaRouter(
+            urls, client_factory=lambda u: ModelClient(
+                u, timeout=30.0, breaker=None,
+                retry=Retry(max_attempts=1)))
+
+        def factory():
+            srv = spawn()
+            return HttpReplica(f"http://127.0.0.1:{srv.port}",
+                               on_retire=lambda: _hard_kill(srv))
+
+        controller = FleetController(
+            [HttpReplica(u, on_retire=(lambda s=s: _hard_kill(s)))
+             for u, s in zip(urls, fleet)],
+            router=router, slo=SLOPolicy(min_requests=10 ** 9),
+            replica_factory=factory, min_replicas=3, max_replicas=3,
+            autoscale_interval_s=0.2, cooldown_s=1e9, holddown_s=60.0)
+
+        results = [None] * len(reqs)
+        failures = []
+        nh_retries = [0]
+        done_evt = threading.Event()
+        idx = _queue.Queue()
+        for i in range(len(reqs)):
+            idx.put(i)
+
+        def worker():
+            while True:
+                try:
+                    i = idx.get_nowait()
+                except _queue.Empty:
+                    return
+                prompt, mx = reqs[i]
+                give_up = time.monotonic() + 60.0
+                while True:
+                    try:
+                        results[i] = router.generate(
+                            prompt, max_new_tokens=mx,
+                            model="decoder", timeout_s=60.0)
+                        break
+                    except NoHealthyReplicaError as e:
+                        # the backfill window: with two replicas down
+                        # at once, healthy membership can dip to zero
+                        # for a beat while the controller backfills; a
+                        # caller that retries loses nothing (the fresh
+                        # attempt restarts from the prompt — greedy
+                        # decode keeps it byte-identical)
+                        if time.monotonic() >= give_up:
+                            failures.append((i, repr(e)))
+                            break
+                        nh_retries[0] += 1
+                        time.sleep(0.1)
+                    except Exception as e:   # noqa: BLE001 - zero-lost is asserted below
+                        failures.append((i, repr(e)))
+                        break
+
+        def eng_stats(srv, key):
+            try:
+                return srv.decode_engines["decoder"].stats()[key]
+            except Exception:   # noqa: BLE001 - replica may be mid-teardown
+                return 0
+
+        def fleet_tokens():
+            return sum(eng_stats(s, "tokens_total") for s in servers)
+
+        drills = []
+
+        def chaos_script():
+            # 1) NaN poison + decode-loop wedge, armed while the fleet
+            # is busy (the poison fires on the next decode step of
+            # whichever engine dispatches first — quarantine + replay;
+            # the wedge fires ~60 loop iterations later — watchdog
+            # teardown + restart). Armed FIRST: the graceful stop in
+            # step 3 blocks long enough that anything armed after it
+            # would land on a finished run.
+            while fleet_tokens() < total_tokens * 0.05:
+                if done_evt.wait(0.005):
+                    return
+            injector().inject("decode.nonfinite", mode="raise",
+                              at_hit=1, times=1)
+            # times=3: the wedge lands on whichever loop threads make
+            # hits 60-62 — wedging up to three threads guarantees at
+            # least one belongs to an engine that is still alive and
+            # watched (a thread mid-teardown has no watchdog and just
+            # sleeps the delay off)
+            injector().inject("decode.hang", mode="delay",
+                              delay_s=1.2, at_hit=60, times=3)
+            drills.append("nonfinite+hang")
+            # 2) hard kill: the in-process SIGKILL — the listening
+            # socket dies NOW (inline); the router sees raw
+            # connection failures, no partial, and those streams
+            # restart from their prompts (greedy decode keeps them
+            # byte-identical) while the controller backfills
+            while fleet_tokens() < total_tokens * 0.15:
+                if done_evt.wait(0.005):
+                    return
+            try:
+                fleet[0]._httpd.socket.close()
+            except (OSError, AttributeError):
+                pass
+            threading.Thread(target=_hard_kill, args=(fleet[0],),
+                             daemon=True,
+                             name="decode-chaos-kill").start()
+            drills.append("hard_kill")
+            # 3) graceful retire with streams in flight: the engines
+            # stop first inside stop(), so the in-flight handlers
+            # return resumable 503 partials immediately and the
+            # router migrates the continuations; the rest of stop()
+            # (listener teardown) can take a while, so it runs in its
+            # own thread and never stalls the script
+            while fleet_tokens() < total_tokens * 0.25:
+                if done_evt.wait(0.005):
+                    return
+            # best-effort: give fleet[1] a beat to have streams in
+            # flight (a stopped replica's tokens leave the sum above,
+            # so a hard AND here can starve), then retire regardless
+            busy_by = time.monotonic() + 2.0
+            while (eng_stats(fleet[1], "active_slots") < 1
+                   and time.monotonic() < busy_by):
+                if done_evt.wait(0.005):
+                    return
+            threading.Thread(target=fleet[1].stop, daemon=True,
+                             name="decode-chaos-retire").start()
+            drills.append("graceful_retire")
+
+        threads = [threading.Thread(target=worker,
+                                    name=f"decode-chaos-{w}")
+                   for w in range(12)]
+        script = threading.Thread(target=chaos_script, daemon=True,
+                                  name="decode-chaos-script")
+        controller.start()
+        try:
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            if chaos:
+                script.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            fired = {p: injector().hits(p)
+                     for p in ("decode.nonfinite", "decode.hang")}
+        finally:
+            done_evt.set()
+            if chaos:
+                script.join(timeout=10.0)
+            controller.stop()
+            for s in servers:
+                _hard_kill(s)
+            injector().clear()
+        if failures:
+            raise AssertionError(
+                f"{'chaos' if chaos else 'control'} arm LOST "
+                f"{len(failures)} request(s): {failures[:3]}")
+        got = [r["tokens"] for r in results]
+        if got != oracle:
+            bad = [i for i, (g, o) in enumerate(zip(got, oracle))
+                   if g != o]
+            raise AssertionError(
+                f"{'chaos' if chaos else 'control'} arm diverged from "
+                f"the sequential oracle on request(s) {bad[:5]} — "
+                "byte-identity bar failed")
+        moved = {k: reg.counter_value(k) - before[k] for k in COUNTERS}
+        moved["no_healthy_retries"] = nh_retries[0]
+        moved["point_hits"] = fired
+        return wall, moved, drills
+
+    off_wall, off_moved, _ = run_arm(chaos=False)
+    on_wall, on_moved, drills = run_arm(chaos=True)
+    if len(drills) != 3:
+        raise AssertionError(
+            f"chaos script only landed {drills} — the arm finished "
+            "before the drills fired; lower the trigger thresholds")
+    if on_moved["dl4j_decode_slot_quarantines_total"] < 1:
+        raise AssertionError(
+            f"NaN poison never quarantined a slot ({on_moved})")
+    if on_moved["dl4j_decode_engine_restarts_total"] < 1:
+        raise AssertionError("decode.hang never forced an engine "
+                             f"restart — watchdog did not fire "
+                             f"({on_moved})")
+    if on_moved["dl4j_decode_replays_total"] < 1:
+        raise AssertionError("no stream was ever replayed")
+    config = (f"CausalTransformer v{model.vocab_size} d{model.d_model}"
+              f" h{model.n_heads} L{model.n_layers} ctx{model.max_ctx}"
+              f" f32; {n_requests} requests prompts 4-32 outputs "
+              f"24-64, 3 replicas (max_slots={max_slots} page=16, "
+              "shared compiled programs), 12 closed-loop clients "
+              "through ReplicaRouter + FleetController(min=max=3); "
+              "drills: hard kill + graceful retire + decode.nonfinite "
+              "+ decode.hang(watchdog 0.5s); both arms byte-identical "
+              "to the sequential oracle, zero lost")
+    base = {"metric": "decode_chaos_goodput_tokens_per_sec",
+            "unit": "tok/s end-to-end through the replica router",
+            "tokens": total_tokens, "requests": n_requests,
+            "config": config}
+    off_doc = dict(base, value=round(total_tokens / off_wall, 1),
+                   wall_s=round(off_wall, 3), mode="control_no_chaos",
+                   counters_moved=off_moved)
+    on_doc = dict(base, value=round(total_tokens / on_wall, 1),
+                  wall_s=round(on_wall, 3), mode="chaos",
+                  vs_baseline=round(off_wall / on_wall, 3),
+                  counters_moved=on_moved, drills=drills,
+                  zero_lost=True, byte_identical=True)
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        for doc in (off_doc, on_doc):
+            doc["device"] = str(dev.device_kind)
+            doc["platform"] = str(dev.platform)
+            doc["jax"] = jax.__version__
+    except Exception:   # noqa: BLE001 - device facts are best-effort
+        pass
+    return off_doc, on_doc
+
+
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] in ("decode_chaos",
+                                             "decode-chaos"):
+        n = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+        off_doc, on_doc = bench_decode_chaos(n_requests=n)
+        with open("BENCH_decode_chaos_off.json", "w") as f:
+            json.dump(off_doc, f, indent=2)
+        with open("BENCH_decode_chaos.json", "w") as f:
+            json.dump(on_doc, f, indent=2)
+        print(json.dumps(on_doc))
+        return
+
     if len(sys.argv) > 1 and sys.argv[1] == "decode":
         n = int(sys.argv[2]) if len(sys.argv) > 2 else 64
         off_doc, on_doc = bench_decode(n_requests=n)

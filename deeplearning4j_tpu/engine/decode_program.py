@@ -169,7 +169,15 @@ class DecodeProgram:
             xf = layer_norm(x, params["lnf_g"], params["lnf_b"])
             logits = lm_logits(xf, params["tok_emb"])
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return kv, nxt
+            # per-slot finite-logits verdict (the NonFiniteGuard
+            # discipline applied to serving): ONE fused reduction over
+            # the logits the step already materialized, so slot health
+            # rides the same dispatch — a False row means this slot's
+            # numerics are poison and its emitted token must not be
+            # trusted (DecodeEngine quarantines the slot and replays
+            # the request on a healthy one)
+            ok = jnp.all(jnp.isfinite(logits), axis=-1)
+            return kv, nxt, ok
 
         return jax.jit(decode_fn, donate_argnums=(1,))
 
@@ -222,8 +230,10 @@ class DecodeProgram:
     def step(self, kv, tokens, positions):
         """One decode step over all slots. `tokens`/`positions` are
         host [max_slots] int arrays (the engine's slot table); returns
-        (new_kv, next_tokens) with `kv` donated — the caller MUST
-        rebind. Inactive slots compute harmlessly (their writes land
+        (new_kv, next_tokens, finite_ok) with `kv` donated — the
+        caller MUST rebind. `finite_ok` is the per-slot finite-logits
+        verdict ([max_slots] bool): a False row's token is numeric
+        poison. Inactive slots compute harmlessly (their writes land
         on pages the masks keep dead until a prefill reclaims them);
         the host decides whose outputs are real."""
         import jax.numpy as jnp
@@ -254,8 +264,8 @@ class DecodeProgram:
         Returns the (donated-through) cache buffer."""
         for b in (buckets or (self.page_size,)):
             kv, _ = self.prefill(kv, [0] * int(b), 0)
-        kv, _ = self.step(kv, np.zeros(self.max_slots, np.int32),
-                          np.zeros(self.max_slots, np.int32))
+        kv, _, _ = self.step(kv, np.zeros(self.max_slots, np.int32),
+                             np.zeros(self.max_slots, np.int32))
         return kv
 
     def trace_stats(self) -> dict:
@@ -291,7 +301,7 @@ class DecodeProgram:
                           jnp.zeros(self.max_slots, jnp.int32),
                           jnp.zeros(self.max_slots, jnp.int32)),
             precision_policy=self.precision_policy, source=source,
-            consumed_outputs=(0, 1))]
+            consumed_outputs=(0, 1, 2))]
         for b in (buckets or (self.page_size,)):
             b = int(b)
             fn = self._prefill_program(b)

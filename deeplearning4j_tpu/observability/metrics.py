@@ -105,6 +105,12 @@ REGISTERED_METRICS = frozenset({
     "dl4j_decode_tokens_per_s",
     "dl4j_decode_prefill_seconds",
     "dl4j_decode_slot_evictions_total",
+    # decode durability (quarantine / migration / watchdog / deadlines)
+    "dl4j_decode_slot_quarantines_total",
+    "dl4j_decode_migrations_total",
+    "dl4j_decode_replays_total",
+    "dl4j_decode_deadline_expired_total",
+    "dl4j_decode_engine_restarts_total",
     "dl4j_jit_traces_total",
     "dl4j_jit_compiles_total",
     # performance introspection (observability/perf.py)

@@ -392,27 +392,61 @@ class ModelServer:
             eos_id = req.get("eos_id")
             eos_id = None if eos_id is None else int(eos_id)
             timeout_s = float(req.get("timeout_s", 60.0))
+            deadline_s = req.get("deadline_s")
+            deadline_s = None if deadline_s is None else float(deadline_s)
+            resume = req.get("resume_tokens")
+            if resume is not None:
+                resume = [int(t) for t in np.asarray(resume).ravel()]
         except (TypeError, ValueError) as e:
             raise _ClientError(f"bad generate parameters: {e}") \
                 from None
         tenant = tenant or req.get("tenant")
         if not engine.running:
+            if not self._ready:
+                # retiring replica: never restart a decode loop the
+                # shutdown path already tore down — tell the caller to
+                # take its generation elsewhere instead
+                raise ShutdownError("server stopping; replica retiring")
             # lazily start the decode loop; stop() tears down only
             # loops this server started (caller-owned engines keep
             # running — the caller-owned ParallelInference rule)
             engine.ensure_started()
             self._started_engines.add(name)
         try:
-            handle = engine.generate(prompt, max_new, eos_id=eos_id,
-                                     tenant=tenant,
-                                     timeout_s=timeout_s)
+            handle = engine.submit(prompt, max_new, eos_id=eos_id,
+                                   tenant=tenant, deadline_s=deadline_s,
+                                   resume_tokens=resume)
         except ValueError as e:
             raise _ClientError(str(e)) from None
+        try:
+            handle.result(timeout_s=timeout_s)
+        except ShutdownError as e:
+            # replica retiring mid-generation: the 503 body carries the
+            # tokens decoded so far plus a `resumable` marker, so the
+            # caller (ModelClient / ReplicaRouter) can re-dispatch the
+            # request to a healthy replica as a continuation instead of
+            # losing the work
+            e.partial = {"tokens": handle.tokens_so_far(),
+                         "finish_reason": "migrated",
+                         "model": name, "resumable": True}
+            raise
+        except TimeoutError:
+            # transport-level wait budget, distinct from the engine's
+            # own deadline sweep: free the slot and surface a resumable
+            # 503 with whatever was decoded (same continuation contract)
+            handle.cancel()
+            err = DeadlineExceededError(
+                f"generation exceeded timeout_s={timeout_s}")
+            err.partial = {"tokens": handle.tokens_so_far(),
+                           "finish_reason": "timeout",
+                           "model": name, "resumable": True}
+            raise err from None
         return {
             "tokens": handle.tokens_so_far(),
             "model": name,
             "finish_reason": handle.finish_reason,
             "evictions": handle.evictions,
+            "replays": handle.replays,
         }
 
     # ------------------------------------------------- lifecycle routes
@@ -548,9 +582,16 @@ class ModelServer:
             def _send_error(self, code, exc, headers=()):
                 _obs.count("dl4j_serving_errors_total",
                            labels={"code": str(code)})
-                self._send(code, {"error": str(exc),
-                                  "error_class": type(exc).__name__},
-                           headers)
+                body = {"error": str(exc),
+                        "error_class": type(exc).__name__}
+                # a retiring replica attaches the partial generation
+                # (tokens so far + resumable marker) to the exception;
+                # ship it in the error body so the caller can migrate
+                # the request instead of restarting from scratch
+                partial = getattr(exc, "partial", None)
+                if isinstance(partial, dict):
+                    body.update(partial)
+                self._send(code, body, headers)
 
             def _send_404(self):
                 self._send(404, {"error": f"no route {self.path}",
@@ -698,7 +739,13 @@ class ModelServer:
                         tenant=self.headers.get("X-Tenant"))
                     _obs.observe("dl4j_serving_request_seconds",
                                  time.perf_counter() - t0)
-                    if binary:
+                    if resp.get("finish_reason") == "deadline":
+                        # request deadline expired mid-generation: 504
+                        # with the partial stream in a JSON body (both
+                        # wires — the client reads HTTP error bodies as
+                        # JSON, so npz framing would hide the tokens)
+                        self._send(504, resp)
+                    elif binary:
                         # the VARIABLE-LENGTH token output rides as a
                         # raw int32 array entry, length set by this
                         # request's generation alone
@@ -780,6 +827,17 @@ class ModelServer:
 
     def stop(self):
         self._ready = False   # flip /readyz before tearing anything down
+        # stop decode-engine loops BEFORE the HTTP listener: in-flight
+        # generate handlers unblock with ShutdownError and answer 503
+        # with their partial streams over still-open connections — the
+        # migration handoff — instead of dying with the socket. Only
+        # loops THIS server started are stopped; caller-started engines
+        # keep running (the PI ownership rule).
+        for name in sorted(self._started_engines):
+            engine = self.decode_engines.get(name)
+            if engine is not None:
+                engine.stop()
+        self._started_engines.clear()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -787,13 +845,6 @@ class ModelServer:
         if self._thread is not None:
             self._thread.join(timeout=2.0)
             self._thread = None
-        # stop only the decode-engine loops THIS server started —
-        # caller-started engines keep running (the PI ownership rule)
-        for name in sorted(self._started_engines):
-            engine = self.decode_engines.get(name)
-            if engine is not None:
-                engine.stop()
-        self._started_engines.clear()
         if self._owns_registry:
             # the registry shuts down only the ParallelInference
             # front-ends it built — never a caller-supplied one
@@ -999,15 +1050,71 @@ class ModelClient:
                  eos_id: Optional[int] = None,
                  model: Optional[str] = None,
                  tenant: Optional[str] = None,
-                 timeout_s: Optional[float] = None) -> dict:
+                 timeout_s: Optional[float] = None,
+                 deadline_s: Optional[float] = None,
+                 resume_tokens=None,
+                 max_resumes: int = 3) -> dict:
         """POST /v1/models/<model>/generate — continuous-batched
         autoregressive generation. Returns {"tokens": [int, ...],
-        "finish_reason": "eos"|"length", ...}; the token list length
-        varies per request (eos can cut it short). Binary npz wire by
-        default: the prompt ships as a raw int array and the
-        variable-length output comes back as one — same
+        "finish_reason": "eos"|"length"|"deadline", ...}; the token
+        list length varies per request (eos can cut it short). Binary
+        npz wire by default: the prompt ships as a raw int array and
+        the variable-length output comes back as one — same
         fall-back-to-JSON discipline as `predict`. Slot exhaustion
-        surfaces as a 429 ServingError with Retry-After."""
+        surfaces as a 429 ServingError with Retry-After.
+
+        Generation durability: a replica that retires mid-generation
+        answers 503 with the tokens decoded so far and a `resumable`
+        marker; this client re-issues the request as a CONTINUATION
+        carrying those tokens (`resume_tokens` on the wire, up to
+        `max_resumes` times), so the final stream is byte-identical to
+        an uninterrupted call — greedy decode replay, not re-sampling.
+        `deadline_s` rides to the engine's deadline sweep; an expired
+        deadline comes back as HTTP 504 whose partial stream is
+        returned here as a normal dict with finish_reason="deadline"."""
+        resume = ([int(t) for t in np.asarray(resume_tokens).ravel()]
+                  if resume_tokens is not None else [])
+        last: Optional[Exception] = None
+        for _ in range(max(0, int(max_resumes)) + 1):
+            try:
+                return self._generate_once(
+                    prompt, max_new_tokens, eos_id=eos_id, model=model,
+                    tenant=tenant, timeout_s=timeout_s,
+                    deadline_s=deadline_s,
+                    resume_tokens=resume or None)
+            except (ServingError, RetriesExhaustedError) as e:
+                partial = self._resumable_partial(e)
+                if partial is None:
+                    raise
+                # re-raised on budget exhaustion: the LAST resumable
+                # failure still carries its partial body, so an outer
+                # router can keep migrating where this client stopped
+                last = e
+                got = partial.get("tokens") or []
+                if len(got) > len(resume):
+                    resume = [int(t) for t in got]
+        raise last
+
+    @staticmethod
+    def _resumable_partial(e: Exception) -> Optional[dict]:
+        """The server's resumable-partial body out of a generate
+        failure, or None when the failure carries no continuation
+        (connection refused, plain 503, 4xx...)."""
+        if isinstance(e, RetriesExhaustedError):
+            e = e.cause
+        if not isinstance(e, ServingError):
+            return None
+        body = e.body or {}
+        if body.get("resumable") and body.get("tokens") is not None:
+            return body
+        return None
+
+    def _generate_once(self, prompt, max_new_tokens: int,
+                       eos_id: Optional[int], model: Optional[str],
+                       tenant: Optional[str],
+                       timeout_s: Optional[float],
+                       deadline_s: Optional[float],
+                       resume_tokens: Optional[list]) -> dict:
         model = model or "default"
         route = f"/v1/models/{model}/generate"
         meta = {"max_new_tokens": int(max_new_tokens)}
@@ -1017,26 +1124,38 @@ class ModelClient:
             meta["tenant"] = tenant
         if timeout_s is not None:
             meta["timeout_s"] = float(timeout_s)
-        if self._npz_ok:
-            try:
-                resp = self._request_bytes(
-                    route,
-                    encode_npz_request(
-                        np.asarray(prompt, np.int32), meta),
-                    NPZ_CONTENT_TYPE)
-                out = resp.pop("outputs", None)
-                if out is not None and "tokens" not in resp:
-                    resp["tokens"] = [int(t) for t in
-                                      np.asarray(out).ravel()]
-                return resp
-            except ServingError as e:
-                if self.wire == "npz" or not self._old_server_error(e):
-                    raise
-                self._npz_ok = False   # old server: JSON from here on
-        payload = {"prompt": [int(t) for t in
-                              np.asarray(prompt).ravel()]}
-        payload.update(meta)
-        return self._request(route, payload)
+        if deadline_s is not None:
+            meta["deadline_s"] = float(deadline_s)
+        if resume_tokens:
+            meta["resume_tokens"] = [int(t) for t in resume_tokens]
+        try:
+            if self._npz_ok:
+                try:
+                    resp = self._request_bytes(
+                        route,
+                        encode_npz_request(
+                            np.asarray(prompt, np.int32), meta),
+                        NPZ_CONTENT_TYPE)
+                    out = resp.pop("outputs", None)
+                    if out is not None and "tokens" not in resp:
+                        resp["tokens"] = [int(t) for t in
+                                          np.asarray(out).ravel()]
+                    return resp
+                except ServingError as e:
+                    if self.wire == "npz" \
+                            or not self._old_server_error(e):
+                        raise
+                    self._npz_ok = False   # old server: JSON now on
+            payload = {"prompt": [int(t) for t in
+                                  np.asarray(prompt).ravel()]}
+            payload.update(meta)
+            return self._request(route, payload)
+        except ServingError as e:
+            if e.status == 504 and e.body.get("tokens") is not None:
+                # deadline expired server-side: the 504 body IS the
+                # partial result — surface it as one
+                return dict(e.body)
+            raise
 
     def status(self, model: Optional[str] = None) -> dict:
         if model is not None:

@@ -116,6 +116,20 @@ class RestartsExhaustedError(ResilienceError):
         self.ledger = ledger or []
 
 
+class GenerationPoisonedError(ResilienceError):
+    """One generation request produced non-finite logits on every slot
+    it was replayed onto — the poison travels WITH the request (its
+    tokens drive the numerics), so further replays would quarantine
+    healthy slots one by one. The engine aborts the request with this
+    typed error after `poison_strike_limit` strikes instead of looping.
+    `strikes` is how many slots the request poisoned before the abort."""
+
+    def __init__(self, msg: str, model: str = "", strikes: int = 0):
+        super().__init__(msg)
+        self.model = model
+        self.strikes = strikes
+
+
 class QuotaExceededError(ResilienceError):
     """A tenant's token-bucket quota is spent (or its priority class
     was shed under queue pressure before reaching the bounded queue).

@@ -75,6 +75,26 @@ Fault points wired through the stack:
                      METERED tenants (unmetered/high classes are
                      untouched): a synthetic quota storm that must land
                      on the metered classes without starving gold
+  decode.nonfinite   DecodeEngine.step_once, once per decode dispatch —
+                     `raise` is consumed as a forced "non-finite
+                     logits" verdict on the lowest-indexed active slot
+                     (the NaN-poison drill without corrupting shared
+                     weights): the slot is quarantined forever, its
+                     request replayed on a healthy slot byte-identically;
+                     repeated strikes on one request abort it with
+                     GenerationPoisonedError
+  decode.hang        DecodeEngine loop thread, once per iteration
+                     BEFORE the step (outside the step lock) — `delay`
+                     wedges the decode loop so the engine watchdog
+                     escalates to teardown + bounded restart with every
+                     live request recovered via replay
+  serving.migrate_fail  ReplicaRouter generate failover, once per
+                     cross-replica migration re-dispatch — `raise` is
+                     consumed by DROPPING the tokens-so-far continuation
+                     (the migration itself failed): the request restarts
+                     from its original prompt on the next healthy
+                     replica, still losing nothing (greedy decode is
+                     deterministic, so the output is unchanged)
 
 `REGISTERED_POINTS` is the canonical registry: every `fire(...)` site
 in the package must use a name listed there, and the test suite pins
@@ -113,6 +133,8 @@ REGISTERED_POINTS = frozenset({
     "admission.quota_storm",
     "checkpoint.write",
     "data.next",
+    "decode.hang",
+    "decode.nonfinite",
     "dist.heartbeat_stale",
     "dist.spare_exhausted",
     "inference.batch",
@@ -120,6 +142,7 @@ REGISTERED_POINTS = frozenset({
     "obs.emit",
     "rollout.canary_poison",
     "serve.request",
+    "serving.migrate_fail",
     "serving.replica_kill",
     "serving.slot_evict",
     "train.grad_nonfinite",

@@ -33,6 +33,40 @@ its neighbors are, and when it joins. tests/test_decode.py pins
 engine output == sequential per-request oracle under staggered churn
 AND mid-soak eviction chaos.
 
+Generation durability (the crash-proof layer on the same replay
+mechanism — a generation request is a durable object, not
+slot-lifetime ephemera):
+
+  continuation  `submit(resume_tokens=[...])` re-enters a stream that
+          already emitted tokens ELSEWHERE (an evicted replica, a
+          dropped connection): re-prefill + forced replay of the
+          recorded tokens, then greedy continuation — byte-identical
+          to an uninterrupted run. This is the eviction-recovery path
+          crossing process boundaries (the wire field ModelServer /
+          ReplicaRouter migration rides).
+  quarantine  the decode step returns a per-slot finite-logits
+          verdict (engine/decode_program.py, the NonFiniteGuard
+          discipline applied to serving); a non-finite slot is
+          quarantined — NEVER reused — and its request replayed on a
+          healthy slot. Poison that travels WITH a request (its own
+          tokens drive the numerics) aborts with
+          GenerationPoisonedError after `poison_strike_limit` strikes
+          instead of quarantining the fleet slot by slot. The
+          `decode.nonfinite` fault point forces the verdict
+          deterministically.
+  watchdog  `watchdog_timeout_s=` arms a StepWatchdog
+          (resilience/supervisor.py) over the loop thread's
+          heartbeats; a hung iteration (the `decode.hang` drill)
+          escalates to engine teardown + bounded restart
+          (`max_engine_restarts`): fresh KV cache, every live request
+          re-queued as a replay continuation — never an indefinite
+          hang, never a lost stream.
+  deadline  `submit(deadline_s=)` / `GenerationHandle.cancel()` free
+          the slot at the next step boundary and finish the handle
+          with its PARTIAL tokens and an explicit finish_reason
+          ("deadline" / "cancelled") — surfaced as 504/partial over
+          HTTP.
+
 Admission rides the same vocabulary as the fixed-shape plane: an
 optional AdmissionController (tenant quotas / priority shed) in front,
 and a hard capacity bound (`max_slots` resident + `queue_limit`
@@ -49,18 +83,35 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from collections import deque
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from deeplearning4j_tpu.observability import metrics as _obs
 from deeplearning4j_tpu.resilience.errors import (
     FaultInjectedError,
+    GenerationPoisonedError,
     QuotaExceededError,
+    RestartsExhaustedError,
     ShutdownError,
 )
 from deeplearning4j_tpu.resilience.faults import fire as _fire
+
+# every engine constructed in this process (weak — dead engines drop
+# out); tests/conftest.py reaps whatever a failed chaos test left
+# running so no loop/watchdog thread leaks into later tier-1 tests
+_LIVE_ENGINES: "weakref.WeakSet[DecodeEngine]" = weakref.WeakSet()
+
+
+def reap_stray_engines() -> None:
+    """Stop every engine still running (loop thread, watchdog, zombie
+    restart threads). Teardown backstop for chaos tests — idempotent,
+    touches nothing if every engine was stopped properly."""
+    for eng in list(_LIVE_ENGINES):
+        if eng.running or eng._watchdog is not None:
+            eng.stop()
 
 
 class GenerationHandle:
@@ -68,15 +119,25 @@ class GenerationHandle:
 
     Thread-safe: the engine loop appends, any number of consumers
     read. `finish_reason` is "eos" (the eos token was emitted — it IS
-    included in the output) or "length" (max_new_tokens reached)."""
+    included in the output), "length" (max_new_tokens reached),
+    "deadline" (the submit deadline expired — the tokens are a
+    PARTIAL result), or "cancelled" (`cancel()` was honored — also
+    partial). Failure finishes carry reason None and an error that
+    `result()` re-raises."""
 
     def __init__(self, prompt: List[int], max_new_tokens: int,
-                 eos_id: Optional[int]):
+                 eos_id: Optional[int],
+                 deadline_s: Optional[float] = None):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.eos_id = eos_id
         self.finish_reason: Optional[str] = None
         self.evictions = 0
+        self.replays = 0
+        self.poison_strikes = 0
+        self._deadline = (time.monotonic() + float(deadline_s)
+                          if deadline_s is not None else None)
+        self._cancel_requested = False
         self._tokens: List[int] = []
         self._cond = threading.Condition()
         self._done = False
@@ -101,6 +162,14 @@ class GenerationHandle:
         with self._cond:
             return self._done
 
+    def cancel(self) -> None:
+        """Request cancellation: the engine frees the slot at its next
+        step boundary and finishes the handle with the tokens emitted
+        so far and finish_reason "cancelled"."""
+        with self._cond:
+            self._cancel_requested = True
+            self._cond.notify_all()
+
     def result(self, timeout_s: Optional[float] = 60.0) -> List[int]:
         with self._cond:
             if not self._cond.wait_for(lambda: self._done,
@@ -116,6 +185,14 @@ class GenerationHandle:
     def _append(self, tok: int) -> None:
         with self._cond:
             self._tokens.append(tok)
+            self._cond.notify_all()
+
+    def _preload(self, tokens: Sequence[int]) -> None:
+        """Seed already-emitted tokens into a fresh handle (wire
+        continuation: the stream's earlier life happened on another
+        replica / connection)."""
+        with self._cond:
+            self._tokens.extend(int(t) for t in tokens)
             self._cond.notify_all()
 
     def _finish(self, reason: Optional[str],
@@ -134,12 +211,20 @@ class DecodeEngine:
     explicit `step_once()` calls — the deterministic-test drive)
     advances every resident stream one token per compiled dispatch.
     One DecodeProgram = one decode compile serves arbitrary join/leave
-    traffic; `stats()["trace_counts"]` is the pin."""
+    traffic; `stats()["trace_counts"]` is the pin.
+
+    `watchdog_timeout_s=` supervises the loop thread: heartbeats feed
+    a StepWatchdog whose escalation tears the engine down and restarts
+    it (bounded by `max_engine_restarts`), recovering every live
+    request via replay."""
 
     def __init__(self, model=None, max_slots: int = 8,
                  page_size: int = 16, queue_limit: Optional[int] = None,
                  admission=None, model_name: str = "decoder",
-                 program=None, max_prefills_per_step: int = 1):
+                 program=None, max_prefills_per_step: int = 1,
+                 watchdog_timeout_s: Optional[float] = None,
+                 max_engine_restarts: int = 3,
+                 poison_strike_limit: int = 2):
         from deeplearning4j_tpu.engine.decode_program import (
             DecodeProgram,
         )
@@ -160,11 +245,15 @@ class DecodeEngine:
         # how many joins one step pays for so an admission burst can't
         # stall resident streams (the prefill-vs-decode phase split)
         self.max_prefills_per_step = max(1, int(max_prefills_per_step))
+        self.watchdog_timeout_s = watchdog_timeout_s
+        self.max_engine_restarts = int(max_engine_restarts)
+        self.poison_strike_limit = int(poison_strike_limit)
         self.kv = program.init_kv()
         s = self.max_slots
         self._tokens = np.zeros(s, np.int32)
         self._positions = np.zeros(s, np.int32)
         self._active = np.zeros(s, bool)
+        self._quarantined = np.zeros(s, bool)
         self._slot_req: List[Optional[GenerationHandle]] = [None] * s
         self._slot_replay: List[Optional[deque]] = [None] * s
         # pending entries: (handle, replay_tokens or None)
@@ -177,12 +266,24 @@ class DecodeEngine:
         self._step_lock = threading.Lock()
         self._running = False
         self._thread: Optional[threading.Thread] = None
+        self._watchdog = None
+        # restart epoch: a loop thread abandoned by a watchdog restart
+        # sees the bumped epoch when it wakes and exits without
+        # touching the rebuilt state
+        self._epoch = 0
+        self._zombies: List[threading.Thread] = []
         self._t0 = time.monotonic()
         self._tokens_emitted = 0
         self._steps = 0
         self._prefills = 0
         self._evictions = 0
         self._completed = 0
+        self._quarantines = 0
+        self._replays = 0
+        self._deadline_expired = 0
+        self._cancelled = 0
+        self._restarts = 0
+        _LIVE_ENGINES.add(self)
 
     # -------------------------------------------------------- lifecycle
     def start(self) -> "DecodeEngine":
@@ -190,10 +291,25 @@ class DecodeEngine:
             if self._running:
                 return self
             self._running = True
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="DecodeEngine-loop")
-        self._thread.start()
+            epoch = self._epoch
+        if self.watchdog_timeout_s and self._watchdog is None:
+            from deeplearning4j_tpu.resilience.supervisor import (
+                StepWatchdog,
+            )
+
+            self._watchdog = StepWatchdog(
+                timeout_s=self.watchdog_timeout_s,
+                on_hang=self._on_hang)
+            self._watchdog.start()
+        self._spawn_loop(epoch)
         return self
+
+    def _spawn_loop(self, epoch: int) -> None:
+        name = ("DecodeEngine-loop" if not self._restarts
+                else f"DecodeEngine-loop-r{self._restarts}")
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=name, args=(epoch,))
+        self._thread.start()
 
     @property
     def running(self) -> bool:
@@ -210,9 +326,15 @@ class DecodeEngine:
             pending = list(self._pending)
             self._pending.clear()
             self._cond.notify_all()
+        if self._watchdog is not None:
+            self._watchdog.stop()
+            self._watchdog = None
         if self._thread is not None:
             self._thread.join(timeout=10.0)
             self._thread = None
+        for z in self._zombies:
+            z.join(timeout=2.0)
+        self._zombies = []
         # fail whatever never reached a slot; resident streams keep
         # their partial output readable (tokens_so_far) but never
         # finish — mark them failed too so result() callers unblock
@@ -224,25 +346,124 @@ class DecodeEngine:
                 self._slot_req[s]._finish(None, error=err)
                 self._free_slot(s)
 
-    def _loop(self) -> None:
+    def _loop(self, epoch: int) -> None:
         while True:
             with self._cond:
-                if not self._running:
+                if not self._running or epoch != self._epoch:
                     return
+            try:
+                # `decode.hang` chaos site: a `delay` spec wedges the
+                # loop HERE — outside the step lock, before the beat —
+                # so the watchdog sees heartbeats go stale exactly as
+                # it would for a dispatch stuck in the runtime
+                _fire("decode.hang")
+            except FaultInjectedError:
+                pass
+            with self._cond:
+                # a watchdog restart may have replaced this thread
+                # while it was wedged: leave without touching state
+                if not self._running or epoch != self._epoch:
+                    return
+            if self._watchdog is not None:
+                self._watchdog.beat("decode", self._steps)
             worked = self.step_once()
             if not worked:
                 with self._cond:
-                    if self._running:
+                    if self._running and epoch == self._epoch:
                         self._cond.wait(timeout=0.02)
+
+    # --------------------------------------------------- hang recovery
+    def _on_hang(self, phase: str, age_s: float) -> None:
+        """StepWatchdog escalation (runs on the watchdog monitor
+        thread): the loop thread went silent — tear the engine down
+        and restart it with every live request recovered via replay,
+        up to `max_engine_restarts`."""
+        self._restart_engine(f"decode loop hung in phase {phase!r} "
+                             f"({age_s:.1f}s without a heartbeat)")
+
+    def _restart_engine(self, reason: str) -> None:
+        with self._cond:
+            if not self._running:
+                return
+            self._epoch += 1        # abandoned thread exits on wake
+            epoch = self._epoch
+            exhausted = self._restarts >= self.max_engine_restarts
+            if not exhausted:
+                self._restarts += 1
+            if self._thread is not None:
+                self._zombies.append(self._thread)
+                self._thread = None
+            if exhausted:
+                self._running = False
+            pending = list(self._pending)
+            self._pending.clear()
+        err = (RestartsExhaustedError(
+            f"decode engine gave up after {self.max_engine_restarts} "
+            f"restarts: {reason}") if exhausted else None)
+        # rebuild slot state under the step lock. A loop thread wedged
+        # INSIDE a dispatch would still hold it — bounded wait, then
+        # abandon the lock object with the thread (the stale thread
+        # releases a lock nothing else uses, and its epoch check stops
+        # it before it can touch the rebuilt state).
+        got = self._step_lock.acquire(timeout=2.0)
+        try:
+            live: List[Tuple[GenerationHandle, List[int]]] = []
+            for s in range(self.max_slots):
+                if self._active[s] and self._slot_req[s] is not None:
+                    h = self._slot_req[s]
+                    live.append((h, h.tokens_so_far()))
+            self.kv = self.program.init_kv()
+            self._tokens[:] = 0
+            self._positions[:] = 0
+            self._active[:] = False
+            self._quarantined[:] = False   # fresh KV clears quarantine
+            self._slot_req = [None] * self.max_slots
+            self._slot_replay = [None] * self.max_slots
+            self._placing = 0
+        finally:
+            if got:
+                self._step_lock.release()
+            else:
+                self._step_lock = threading.Lock()
+        if err is not None:
+            for handle, _ in live:
+                handle._finish(None, error=err)
+            for handle, _ in pending:
+                handle._finish(None, error=err)
+            return
+        with self._cond:
+            self._pending.extend(pending)
+            for handle, recorded in reversed(live):
+                handle.replays += 1
+                self._pending.appendleft((handle, recorded or None))
+            self._cond.notify_all()
+        _obs.count("dl4j_decode_engine_restarts_total")
+        if self._watchdog is not None:
+            self._watchdog.beat("restart", self._steps)
+        self._spawn_loop(epoch)
 
     # -------------------------------------------------------- admission
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
                eos_id: Optional[int] = None,
-               tenant: Optional[str] = None) -> GenerationHandle:
+               tenant: Optional[str] = None,
+               deadline_s: Optional[float] = None,
+               resume_tokens: Optional[Sequence[int]] = None
+               ) -> GenerationHandle:
         """Admit one generation request (non-blocking). Raises
         QuotaExceededError (HTTP 429 + Retry-After) on tenant quota /
         priority shed (AdmissionController) or on slot exhaustion —
-        every slot resident and the wait queue full."""
+        every slot resident and the wait queue full.
+
+        `resume_tokens` re-enters a stream that already emitted tokens
+        elsewhere (cross-replica migration / reconnect): the engine
+        re-prefills the ORIGINAL prompt and force-replays the recorded
+        tokens through the shared loop, so the continuation is
+        byte-identical to an uninterrupted run. `max_new_tokens` is
+        the request's ORIGINAL budget (resume tokens count toward it).
+
+        `deadline_s` bounds the request's wall-clock life from this
+        submit: past it, the slot is freed and the handle finishes
+        with its partial tokens and finish_reason "deadline"."""
         prompt = [int(t) for t in np.asarray(prompt, np.int64).ravel()]
         if not prompt:
             raise ValueError("prompt must carry at least one token")
@@ -254,19 +475,35 @@ class DecodeEngine:
                 f"prompt ({len(prompt)}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds max_ctx "
                 f"{self.program.model.max_ctx}")
+        resume = [int(t) for t in resume_tokens or []]
+        if len(resume) > max_new_tokens:
+            raise ValueError(
+                f"resume_tokens ({len(resume)}) exceeds "
+                f"max_new_tokens ({max_new_tokens})")
+        handle = GenerationHandle(prompt, max_new_tokens, eos_id,
+                                  deadline_s=deadline_s)
+        if resume:
+            handle._preload(resume)
+            handle.replays += 1
+            # the earlier life may already have finished the stream
+            if eos_id is not None and resume[-1] == eos_id:
+                handle._finish("eos")
+                return handle
+            if len(resume) >= max_new_tokens:
+                handle._finish("length")
+                return handle
         capacity = self.max_slots + self.queue_limit
         depth = self._in_flight()
         if self.admission is not None:
             self.admission.admit(tenant, self.model_name, depth,
                                  capacity)
-        handle = GenerationHandle(prompt, max_new_tokens, eos_id)
         with self._cond:
             if (int(self._active.sum()) + len(self._pending)
                     + self._placing) >= capacity:
                 shed = True
             else:
                 shed = False
-                self._pending.append((handle, None))
+                self._pending.append((handle, resume or None))
                 self._cond.notify_all()
         if shed:
             raise QuotaExceededError(
@@ -278,11 +515,15 @@ class DecodeEngine:
     def generate(self, prompt: Sequence[int], max_new_tokens: int,
                  eos_id: Optional[int] = None,
                  tenant: Optional[str] = None,
-                 timeout_s: float = 60.0) -> GenerationHandle:
+                 timeout_s: float = 60.0,
+                 deadline_s: Optional[float] = None,
+                 resume_tokens: Optional[Sequence[int]] = None
+                 ) -> GenerationHandle:
         """submit + wait: returns the FINISHED handle (tokens via
         `.tokens_so_far()` / `.result()`)."""
         handle = self.submit(prompt, max_new_tokens, eos_id=eos_id,
-                             tenant=tenant)
+                             tenant=tenant, deadline_s=deadline_s,
+                             resume_tokens=resume_tokens)
         handle.result(timeout_s=timeout_s)
         return handle
 
@@ -293,43 +534,114 @@ class DecodeEngine:
 
     # ------------------------------------------------------------- step
     def step_once(self) -> bool:
-        """One engine iteration: chaos check, admit waiting requests to
-        free slots (bounded prefills), one shared decode dispatch,
-        harvest. Returns False when there was nothing to do. Public so
-        tests drive churn deterministically without the loop thread.
-        Telemetry (fault point, counters, gauges) fires OUTSIDE the
-        step lock — emission is never a blocking op under a lock."""
+        """One engine iteration: deadline/cancel sweep, chaos check,
+        admit waiting requests to free healthy slots (bounded
+        prefills), one shared decode dispatch, per-slot finite-verdict
+        quarantine, harvest. Returns False when there was nothing to
+        do. Public so tests drive churn deterministically without the
+        loop thread. Telemetry (fault points aside, counters, gauges)
+        fires OUTSIDE the step lock — emission is never a blocking op
+        under a lock."""
         try:
             _fire("serving.slot_evict")
             evict = False
         except FaultInjectedError:
             evict = True
         prefill_s: List[float] = []
+        quar_before = self._quarantines
+        replays_before = self._replays
         with self._step_lock:
+            n_deadline, n_cancel = self._sweep_deadlines()
             evicted = self._evict_lowest_active() if evict else 0
             admitted, emitted = self._admit_pending(prefill_s)
             stepped = bool(self._active.any())
             if stepped:
-                self.kv, nxt = self.program.step(self.kv, self._tokens,
-                                                 self._positions)
+                self.kv, nxt, ok = self.program.step(
+                    self.kv, self._tokens, self._positions)
                 nxt_host = np.asarray(nxt)
+                ok_host = np.asarray(ok)
+                try:
+                    # `decode.nonfinite` chaos site: force a poison
+                    # verdict on the lowest active slot — the NaN
+                    # drill without corrupting the shared weights. A
+                    # hit must mean "this decode step" (the verdict it
+                    # corrupts), so the fire cannot move outside the
+                    # step lock; the injector is a flag check, not I/O.
+                    # analyze: allow=thr-blocking-under-lock — chaos hit must align with the decode step it poisons
+                    _fire("decode.nonfinite")
+                except FaultInjectedError:
+                    victims = np.flatnonzero(self._active)
+                    if victims.size:
+                        ok_host = ok_host.copy()
+                        ok_host[victims[0]] = False
                 self._steps += 1
+                self._quarantine_poisoned(ok_host)
                 emitted += self._harvest(nxt_host)
         if evicted:
             _obs.count("dl4j_decode_slot_evictions_total", n=evicted)
+        if n_deadline:
+            _obs.count("dl4j_decode_deadline_expired_total",
+                       n=n_deadline)
+        quar = self._quarantines - quar_before
+        if quar:
+            _obs.count("dl4j_decode_slot_quarantines_total", n=quar)
+        replays = self._replays - replays_before
+        if replays:
+            _obs.count("dl4j_decode_replays_total", n=replays)
         for dt in prefill_s:
             _obs.observe("dl4j_decode_prefill_seconds", dt)
         if emitted:
             _obs.count("dl4j_decode_tokens_total", n=emitted)
         self._publish_gauges()
-        return stepped or admitted
+        return bool(stepped or admitted or evicted or n_deadline
+                    or n_cancel)
+
+    def _sweep_deadlines(self) -> Tuple[int, int]:
+        """Finish expired/cancelled streams with their PARTIAL tokens
+        (explicit finish_reason) and free their slots. Runs at the top
+        of every step — a deadline costs at most one step of slack."""
+        now = time.monotonic()
+
+        def _verdict(handle: GenerationHandle) -> Optional[str]:
+            if handle._cancel_requested:
+                return "cancelled"
+            if handle._deadline is not None and now >= handle._deadline:
+                return "deadline"
+            return None
+
+        n_deadline = n_cancel = 0
+        with self._cond:
+            if self._pending:
+                kept: deque = deque()
+                for handle, replay in self._pending:
+                    reason = _verdict(handle)
+                    if reason is None:
+                        kept.append((handle, replay))
+                        continue
+                    handle._finish(reason)
+                    n_deadline += reason == "deadline"
+                    n_cancel += reason == "cancelled"
+                self._pending = kept
+        for s in range(self.max_slots):
+            if not self._active[s] or self._slot_req[s] is None:
+                continue
+            reason = _verdict(self._slot_req[s])
+            if reason is None:
+                continue
+            self._slot_req[s]._finish(reason)
+            self._free_slot(s)
+            n_deadline += reason == "deadline"
+            n_cancel += reason == "cancelled"
+        self._deadline_expired += n_deadline
+        self._cancelled += n_cancel
+        return n_deadline, n_cancel
 
     def _admit_pending(self, prefill_s: List[float]):
         admitted = False
         emitted = 0
         for _ in range(self.max_prefills_per_step):
             free = [s for s in range(self.max_slots)
-                    if not self._active[s]]
+                    if not self._active[s] and not self._quarantined[s]]
             if not free:
                 break
             with self._cond:
@@ -350,12 +662,12 @@ class DecodeEngine:
                replay: Optional[List[int]], slot: int,
                prefill_s: List[float]) -> int:
         """Prefill `handle`'s prompt into `slot` and make it resident.
-        `replay` (eviction recovery) carries the already-emitted
-        tokens: the re-prefill regenerates the first one (same
-        bucketed program, same prompt — bitwise the same token) and
-        the rest are force-fed through the decode loop instead of
-        re-emitted, so the stream's output is unaffected by the
-        eviction. Returns how many tokens were emitted (0 or 1)."""
+        `replay` (eviction/quarantine/migration recovery) carries the
+        already-emitted tokens: the re-prefill regenerates the first
+        one (same bucketed program, same prompt — bitwise the same
+        token) and the rest are force-fed through the decode loop
+        instead of re-emitted, so the stream's output is unaffected by
+        the recovery. Returns how many tokens were emitted (0 or 1)."""
         t0 = time.perf_counter()
         self.kv, first_dev = self.program.prefill(self.kv,
                                                   handle.prompt, slot)
@@ -369,6 +681,7 @@ class DecodeEngine:
             # forced replay: the recorded token stream IS the truth
             # (greedy decode would regenerate it; forcing makes the
             # recovery independent of it)
+            self._replays += 1
             self._tokens[slot] = replay[0]
             self._slot_replay[slot] = deque(replay[1:]) or None
             return 0
@@ -442,6 +755,36 @@ class DecodeEngine:
             self._cond.notify_all()
         return 1
 
+    # ------------------------------------------------------- quarantine
+    def _quarantine_poisoned(self, ok_host: np.ndarray) -> None:
+        """Apply the per-slot finite-logits verdict: a non-finite slot
+        is quarantined — never offered to `_admit_pending` again, its
+        KV pages written off — and its request replayed on a healthy
+        slot exactly like an eviction. A request that poisons
+        `poison_strike_limit`+1 slots carries the poison in its own
+        tokens: abort it with GenerationPoisonedError instead of
+        quarantining the whole batch one slot at a time."""
+        for s in range(self.max_slots):
+            if not self._active[s] or bool(ok_host[s]):
+                continue
+            handle = self._slot_req[s]
+            recorded = handle.tokens_so_far()
+            self._free_slot(s)
+            self._quarantined[s] = True
+            self._quarantines += 1
+            handle.poison_strikes += 1
+            if handle.poison_strikes > self.poison_strike_limit:
+                handle._finish(None, error=GenerationPoisonedError(
+                    f"generation poisoned {handle.poison_strikes} "
+                    f"slots (limit {self.poison_strike_limit}) — "
+                    f"aborting instead of replaying further",
+                    model=self.model_name,
+                    strikes=handle.poison_strikes))
+                continue
+            with self._cond:
+                self._pending.appendleft((handle, recorded or None))
+                self._cond.notify_all()
+
     # ------------------------------------------------------------ stats
     def _publish_gauges(self) -> None:
         active = int(self._active.sum())
@@ -470,6 +813,12 @@ class DecodeEngine:
             "tokens_total": self._tokens_emitted,
             "completed": self._completed,
             "evictions": self._evictions,
+            "quarantined_slots": int(self._quarantined.sum()),
+            "quarantines": self._quarantines,
+            "replays": self._replays,
+            "deadline_expired": self._deadline_expired,
+            "cancelled": self._cancelled,
+            "engine_restarts": self._restarts,
             "tokens_per_s": round(self.tokens_per_s(), 3),
             "trace_counts": self.program.trace_stats()["trace_counts"],
         }
@@ -483,7 +832,8 @@ def sequential_decode(program, prompt: Sequence[int],
     compiled programs the engine runs, one request at a time. Returns
     (kv, tokens). Continuous-batched output must equal this bitwise
     for every request regardless of slot churn — the correctness bar
-    that makes slot join/leave (and eviction replay) trustworthy."""
+    that makes slot join/leave (and eviction/quarantine/migration
+    replay) trustworthy."""
     if kv is None:
         kv = program.init_kv()
     tokens = np.zeros(program.max_slots, np.int32)
@@ -494,7 +844,7 @@ def sequential_decode(program, prompt: Sequence[int],
     positions[slot] = len(list(prompt))
     while len(out) < max_new_tokens and (eos_id is None
                                          or out[-1] != eos_id):
-        kv, nxt = program.step(kv, tokens, positions)
+        kv, nxt, _ = program.step(kv, tokens, positions)
         positions[slot] += 1
         tok = int(np.asarray(nxt)[slot])
         out.append(tok)

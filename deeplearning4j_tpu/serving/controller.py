@@ -729,6 +729,19 @@ class FleetController:
                 self._remove_dead(h)
 
     def _remove_dead(self, handle) -> None:
+        """Drop a dead replica and retire its backing.
+
+        Generation durability rides on this ordering: membership drops
+        FIRST (drain=False — the replica is dead, nothing to wait for),
+        so the router treats any in-flight failure on it as an
+        orchestrated removal, not replica badness; then `retire()` —
+        for a still-reachable ModelServer that stops the decode engines
+        BEFORE the HTTP listener, so in-flight generations answer 503
+        with their resumable partial streams and the router's
+        `generate` failover re-dispatches them to a healthy replica as
+        continuations. A hard-killed replica leaves no partial; those
+        requests restart from their prompts, which greedy decode makes
+        byte-identical anyway."""
         logger.warning("replica %s is dead; removing from the fleet",
                        handle.name)
         with self._lock:

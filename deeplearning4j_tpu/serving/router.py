@@ -39,10 +39,12 @@ from typing import Callable, List, Optional
 from deeplearning4j_tpu.observability import metrics as _obs
 from deeplearning4j_tpu.resilience.errors import (
     CircuitOpenError,
+    FaultInjectedError,
     NoHealthyReplicaError,
     RetriesExhaustedError,
     ServingError,
 )
+from deeplearning4j_tpu.resilience.faults import fire as _fire
 
 # NOTE: ModelClient is imported lazily inside _default_factory —
 # parallel/serving.py imports this package for the control-plane
@@ -226,6 +228,113 @@ class ReplicaRouter:
                 decode_top: int = 0) -> dict:
         return self._call(lambda r: r.client.predict(
             inputs, decode_top=decode_top, model=model, tenant=tenant))
+
+    @staticmethod
+    def _resumable_partial(exc: Exception) -> Optional[dict]:
+        """The resumable-partial body a retiring replica shipped with
+        its failure, or None when the failure carries none."""
+        if isinstance(exc, RetriesExhaustedError):
+            exc = exc.cause
+        if isinstance(exc, ServingError):
+            body = exc.body or {}
+            if body.get("resumable") and body.get("tokens") is not None:
+                return body
+        return None
+
+    def generate(self, prompt, max_new_tokens: int = 16,
+                 eos_id: Optional[int] = None,
+                 model: Optional[str] = None,
+                 tenant: Optional[str] = None,
+                 timeout_s: Optional[float] = None,
+                 deadline_s: Optional[float] = None,
+                 resume_tokens: Optional[list] = None) -> dict:
+        """One logical generation over the fleet, with cross-replica
+        MIGRATION: when the serving replica dies or retires
+        mid-generation, its resumable 503 body (tokens decoded so far)
+        is re-dispatched to the next healthy replica as a continuation
+        — the target re-prefills the ORIGINAL prompt and force-replays
+        the recorded tokens through the shared decode loop, so the
+        final stream is byte-identical to an un-faulted run. A
+        hard-killed replica leaves no partial; the request restarts
+        from the prompt, which greedy decode makes byte-identical
+        anyway. The armed `serving.migrate_fail` fault drops the
+        continuation (the handoff itself failed) and the request
+        restarts from the prompt — still losing nothing.
+
+        The response dict gains `migrations`: how many times this
+        request's partial stream moved between replicas."""
+        tried: set = set()
+        causes: list = []
+        last: Optional[Exception] = None
+        resume = ([int(t) for t in resume_tokens]
+                  if resume_tokens else [])
+        migrations = 0
+        while True:
+            r = self._pick(tried)
+            if r is None:
+                break
+            tried.add(r.url)
+            continuation = list(resume)
+            if continuation:
+                try:
+                    _fire("serving.migrate_fail")
+                except FaultInjectedError:
+                    # the migration handoff itself failed: drop the
+                    # tokens-so-far and restart from the prompt on this
+                    # replica — greedy decode is deterministic, so the
+                    # output is unchanged either way
+                    continuation = []
+            if continuation:
+                migrations += 1
+                _obs.count("dl4j_decode_migrations_total")
+            try:
+                # max_resumes=0: migration is the ROUTER's job here —
+                # the client surfaces the resumable failure instead of
+                # hammering the same dying replica with continuations
+                out = r.client.generate(
+                    prompt, max_new_tokens, eos_id=eos_id, model=model,
+                    tenant=tenant, timeout_s=timeout_s,
+                    deadline_s=deadline_s,
+                    resume_tokens=continuation or None, max_resumes=0)
+            except _FAILOVER as exc:
+                removed = not self._is_member(r)
+                self._release(r, failed=not removed)
+                partial = self._resumable_partial(exc)
+                if partial is not None:
+                    got = partial.get("tokens") or []
+                    if len(got) > len(resume):
+                        resume = [int(t) for t in got]
+                if not removed:
+                    last = exc
+                    causes.append((r.url, exc))
+                    with self._lock:
+                        self.failovers += 1
+                    _obs.count("dl4j_serving_replica_failovers_total")
+                continue
+            except ServingError as exc:
+                removed = not self._is_member(r)
+                partial = self._resumable_partial(exc)
+                self._release(r, failed=exc.retryable and not removed)
+                if partial is not None:
+                    got = partial.get("tokens") or []
+                    if len(got) > len(resume):
+                        resume = [int(t) for t in got]
+                if not (exc.retryable or partial is not None):
+                    raise       # 400/404/500: same answer anywhere
+                if not removed:
+                    last = exc
+                    causes.append((r.url, exc))
+                    with self._lock:
+                        self.failovers += 1
+                    _obs.count("dl4j_serving_replica_failovers_total")
+                continue
+            self._release(r, failed=False)
+            out["migrations"] = migrations
+            return out
+        raise NoHealthyReplicaError(
+            f"no healthy replica finished the generation "
+            f"(tried {sorted(tried)}; last: {last!r})", cause=last,
+            membership=self.urls(), causes=causes)
 
     def status(self, model: Optional[str] = None) -> dict:
         return self._call(lambda r: r.client.status(model=model))

@@ -430,6 +430,21 @@ def telemetry_lines(snapshot) -> list:
             dec.append(f"{c['dl4j_decode_slot_evictions_total']} "
                        "evictions")
         lines.append("decode — " + " · ".join(dec))
+    # decode durability (quarantine / migration / watchdog restart /
+    # deadline sweep) — shown once any of its counters has moved
+    if any(k in c for k in ("dl4j_decode_slot_quarantines_total",
+                            "dl4j_decode_migrations_total",
+                            "dl4j_decode_engine_restarts_total",
+                            "dl4j_decode_deadline_expired_total")):
+        lines.append(
+            "decode resilience — "
+            f"{c.get('dl4j_decode_slot_quarantines_total', 0)} "
+            "quarantines · "
+            f"{c.get('dl4j_decode_migrations_total', 0)} migrations · "
+            f"{c.get('dl4j_decode_engine_restarts_total', 0)} "
+            "engine restarts · "
+            f"{c.get('dl4j_decode_deadline_expired_total', 0)} "
+            "deadline expiries")
     # performance introspection (observability/perf.py): cost-model
     # MFU gauge, top phases by attributed share, recompile count
     perf = []

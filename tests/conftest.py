@@ -110,6 +110,21 @@ def _reap_cluster_workers():
 
 
 @pytest.fixture(autouse=True)
+def _reap_decode_engines():
+    """Chaos isolation for DECODE LOOPS: a failing/interrupted decode
+    durability test must not leak a DecodeEngine loop thread or armed
+    StepWatchdog into later tests — stop every engine the continuous
+    module still tracks on teardown (threads are named and joined).
+    Lazy: touches nothing unless the module was actually imported."""
+    import sys as _sys
+
+    yield
+    mod = _sys.modules.get("deeplearning4j_tpu.serving.continuous")
+    if mod is not None:
+        mod.reap_stray_engines()
+
+
+@pytest.fixture(autouse=True)
 def _clear_faults():
     """Chaos isolation: no armed fault may leak into the next test."""
     from deeplearning4j_tpu.resilience.faults import injector
