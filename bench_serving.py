@@ -51,6 +51,20 @@ Modes:
       any rate is reported. Writes BENCH_decode_off.json /
       BENCH_decode_on.json on decode_tokens_per_sec, gated by
       `python tools/perf_gate.py --metric decode`.
+  python bench_serving.py decode_prefix [n_requests]
+      shared-prefix page-caching A/B (PR 17): M tenants share one
+      96-token page-aligned system prompt (+4-token unique tails,
+      short outputs) through the SAME warmed DecodeProgram twice.
+      OFF = `prefix_cache=False`: every request pays its full chunked
+      prefill into private pages. ON = the prefix trie maps the shared
+      pages read-only (refcounted, copy-on-write on divergence) so
+      the Kth tenant prefills only its tail. Token outputs asserted
+      IDENTICAL between arms before any rate is reported; docs also
+      carry prefill-chunks-saved (== prefill-FLOPs-saved, chunks are
+      fixed-size) and peak-resident-KV-pages (effective slots per
+      HBM MiB). Writes BENCH_decode_prefix_off.json /
+      BENCH_decode_prefix.json on decode_prefix_tokens_per_sec, gated
+      by `python tools/perf_gate.py --metric decode_prefix`.
   python bench_serving.py decode_chaos [n_requests]
       generation-durability chaos A/B (PR 16): the same mixed request
       set through a 3-replica decode fleet (ReplicaRouter +
@@ -1145,10 +1159,9 @@ def bench_decode(n_requests=64, max_slots=8, seed=0):
               for _ in range(rng.randrange(4, 49))],
              rng.randrange(8, 49)) for _ in range(n_requests)]
 
-    # warmup: every prefill bucket the request set will touch + the
-    # decode step — both arms then run compile-free
-    buckets = sorted({prog.bucket(len(p)) for p, _ in reqs})
-    prog.warmup(prog.init_kv(), buckets=buckets)
+    # warmup: the chunk-prefill / decode-step / page-copy programs —
+    # both arms then run compile-free
+    prog.warmup(prog.init_kv())
 
     def run_naive():
         kv = prog.init_kv()
@@ -1198,6 +1211,129 @@ def bench_decode(n_requests=64, max_slots=8, seed=0):
                   decode_steps=steps,
                   mean_slot_occupancy=round(
                       tokens / max(steps, 1), 2))
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        for doc in (off_doc, on_doc):
+            doc["device"] = str(dev.device_kind)
+            doc["platform"] = str(dev.platform)
+            doc["jax"] = jax.__version__
+    except Exception:   # noqa: BLE001 - device facts are best-effort
+        pass
+    return off_doc, on_doc
+
+
+# ------------------------------------------------ shared-prefix decode
+def bench_decode_prefix(n_requests=32, max_slots=8, seed=0,
+                        page_size=16):
+    """Shared-prefix page-caching A/B (decode_prefix mode — story in
+    the module docstring). M tenants share one page-aligned system
+    prompt; the OFF arm runs the SAME engine with `prefix_cache=False`
+    (every request pays its full chunked prefill), the ON arm maps the
+    shared pages read-only through the prefix trie and only prefills
+    each request's unique tail. Token outputs are asserted identical
+    between arms (the trie path is bitwise-safe) before any rate is
+    reported. Returns (off_doc, on_doc) on decode_prefix_tokens_per_sec
+    plus prefill-chunks-saved and peak-resident-KV accounting."""
+    import random
+
+    from deeplearning4j_tpu.engine.decode_program import DecodeProgram
+    from deeplearning4j_tpu.serving.continuous import DecodeEngine
+    from deeplearning4j_tpu.zoo.decoder import CausalTransformer
+
+    model = CausalTransformer(vocab_size=512, d_model=128, n_heads=8,
+                              n_layers=4, max_ctx=128, seed=7).init()
+    prog = DecodeProgram(model, max_slots=max_slots,
+                         page_size=page_size)
+    rng = random.Random(seed)
+    ps = prog.page_size
+    # a 96-token system prompt (page-aligned for ps in {8,16,32} — the
+    # shareable unit) plus a 4-token unique tail per tenant; short
+    # outputs so prefill cost is a meaningful share of each request
+    system = [rng.randrange(model.vocab_size) for _ in range(96)]
+    reqs = [(system + [rng.randrange(model.vocab_size)
+                       for _ in range(4)],
+             rng.randrange(8, 17)) for _ in range(n_requests)]
+
+    prog.warmup(prog.init_kv())
+
+    def run_arm(shared):
+        eng = DecodeEngine(program=prog, queue_limit=n_requests,
+                           max_prefills_per_step=2,
+                           prefix_cache=shared)
+        # peak stream-backing footprint: logical = page-table entries
+        # summed across resident streams, physical = UNIQUE pages
+        # behind them (sharing collapses logical onto physical)
+        peak = (0, 0)
+        t0 = time.perf_counter()
+        handles = [eng.submit(p, mx) for p, mx in reqs]
+        while any(not h.done for h in handles):
+            eng.step_once()
+            logical, phys = 0, set()
+            for s in range(eng.max_slots):
+                if eng._active[s]:
+                    rows = [p for p in eng._table[s] if p is not None]
+                    logical += len(rows)
+                    phys.update(rows)
+            if logical > peak[0]:
+                peak = (logical, len(phys))
+        dt = time.perf_counter() - t0
+        outs = [h.result(timeout_s=0) for h in handles]
+        return outs, dt, eng.stats(), peak
+
+    # interleave 2 reps per arm; best rep is the headline (transients
+    # only ever slow a rep down — PERF.md hygiene)
+    off_outs, off_dt, off_stats, off_pk = run_arm(shared=False)
+    on_outs, on_dt, on_stats, on_pk = run_arm(shared=True)
+    o2, odt2, _, _ = run_arm(shared=False)
+    s2, sdt2, _, _ = run_arm(shared=True)
+    if not (off_outs == on_outs == o2 == s2):
+        raise AssertionError(
+            "shared-prefix tokens diverged from the unshared arm — "
+            "byte-identity bar failed")
+    off_dt = min(off_dt, odt2)
+    on_dt = min(on_dt, sdt2)
+    tokens = sum(len(t) for t in off_outs)
+    off_chunks = off_stats["prefill_chunks"]
+    on_chunks = on_stats["prefill_chunks"]
+    saved = off_chunks - on_chunks
+    # every chunk dispatch runs the same fixed-size [page_size] prefill
+    # program, so chunks-saved IS the prefill-FLOPs-saved fraction
+    flops_saved = saved / max(off_chunks, 1)
+    lyr = model.n_layers
+    hd = model.d_model // model.n_heads
+    page_bytes = lyr * 2 * model.n_heads * ps * hd * 4
+    config = (f"CausalTransformer v{model.vocab_size} d{model.d_model}"
+              f" h{model.n_heads} L{model.n_layers} ctx{model.max_ctx}"
+              f" f32; {n_requests} tenants sharing a {len(system)}-"
+              f"token system prompt (+4-token unique tails), outputs "
+              f"8-16, max_slots={max_slots} page={ps}, equal n_pages "
+              f"both arms; identical token outputs asserted")
+    base = {"metric": "decode_prefix_tokens_per_sec", "unit": "tok/s",
+            "tokens": tokens, "requests": n_requests, "config": config}
+    def capacity(peak):
+        logical, phys = peak
+        streams = logical / max(prog.pages_per_slot, 1)
+        mib = phys * page_bytes / 2**20
+        return {"peak_logical_pages": logical,
+                "peak_physical_pages": phys,
+                "kv_sharing_factor": round(logical / max(phys, 1), 2),
+                "effective_slots_per_kv_mib": round(
+                    streams / max(mib, 1e-9), 2)}
+
+    off_doc = dict(base, value=round(tokens / off_dt, 1),
+                   wall_s=round(off_dt, 3), mode="prefix_cache_off",
+                   prefill_chunks=off_chunks, **capacity(off_pk))
+    on_doc = dict(base, value=round(tokens / on_dt, 1),
+                  wall_s=round(on_dt, 3), mode="prefix_cache_on",
+                  vs_baseline=round(off_dt / on_dt, 3),
+                  prefill_chunks=on_chunks,
+                  prefill_chunks_saved=saved,
+                  prefill_flops_saved_frac=round(flops_saved, 3),
+                  prefix_requests_hit=on_stats["prefix_requests_hit"],
+                  prefix_page_hits=on_stats["prefix_hits"],
+                  **capacity(on_pk))
     try:
         import jax
 
@@ -1262,8 +1398,7 @@ def bench_decode_chaos(n_requests=64, max_slots=8, seed=0):
     reqs = [([rng.randrange(model.vocab_size)
               for _ in range(rng.randrange(4, 33))],
              rng.randrange(24, 65)) for _ in range(n_requests)]
-    buckets = sorted({prog.bucket(len(p)) for p, _ in reqs})
-    prog.warmup(prog.init_kv(), buckets=buckets)
+    prog.warmup(prog.init_kv())
     oracle = []
     kv = prog.init_kv()
     for prompt, mx in reqs:
@@ -1524,6 +1659,17 @@ def main():
         with open("BENCH_decode_off.json", "w") as f:
             json.dump(off_doc, f, indent=2)
         with open("BENCH_decode_on.json", "w") as f:
+            json.dump(on_doc, f, indent=2)
+        print(json.dumps(on_doc))
+        return
+
+    if len(sys.argv) > 1 and sys.argv[1] in ("decode_prefix",
+                                             "decode-prefix"):
+        n = int(sys.argv[2]) if len(sys.argv) > 2 else 32
+        off_doc, on_doc = bench_decode_prefix(n_requests=n)
+        with open("BENCH_decode_prefix_off.json", "w") as f:
+            json.dump(off_doc, f, indent=2)
+        with open("BENCH_decode_prefix.json", "w") as f:
             json.dump(on_doc, f, indent=2)
         print(json.dumps(on_doc))
         return

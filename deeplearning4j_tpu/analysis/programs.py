@@ -219,16 +219,18 @@ def build_default_records() -> List[ProgramRecord]:
 
 
 def _decode_records() -> List[ProgramRecord]:
-    """The continuous-batching decode programs at CPU-lintable dims,
-    built through the same JitCache paths DecodeEngine runs (policy
-    registered, donation declared on the paged KV cache)."""
+    """The continuous-batching decode programs at CPU-lintable dims —
+    paged decode step, chunked prefill, and the copy-on-write page
+    copy — built through the same JitCache paths DecodeEngine runs
+    (policy registered, donation of the physical page pool DECLARED so
+    prog-unhonored-donation checks the executable alias map)."""
     from deeplearning4j_tpu.engine.decode_program import DecodeProgram
     from deeplearning4j_tpu.zoo.decoder import CausalTransformer
 
     model = CausalTransformer(vocab_size=64, d_model=32, n_heads=4,
                               n_layers=2, max_ctx=64, seed=17).init()
     prog = DecodeProgram(model, max_slots=4, page_size=16)
-    return prog.lint_records(buckets=(16,))
+    return prog.lint_records()
 
 
 def _mesh_records() -> List[ProgramRecord]:

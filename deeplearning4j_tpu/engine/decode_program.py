@@ -1,4 +1,5 @@
-"""DecodeProgram: the compiled half of continuous-batching decode.
+"""DecodeProgram: the compiled half of continuous-batching decode,
+over a PAGED KV virtual address space.
 
 The serving sibling of StepProgram — one model's autoregressive
 programs, compiled ONCE per shape and never again (the static-shape
@@ -7,36 +8,56 @@ constraint that makes one-program XLA serving work at all, per
 
   decode step   ONE program over the engine's fixed [max_slots] batch:
                 consume each slot's current token at its current
-                position, write that position's K/V into the slot's
-                cache pages (donated, in-place), attend under per-slot
-                length masks, emit each slot's greedy next token.
-                Requests joining/leaving slots is pure DATA — the
-                compiled shape never changes, so arbitrary join/leave
-                traffic runs on one compile (pinned by trace counters).
-  prefill       one program per pow2, page-aligned prompt bucket
-                [bucket_len]: process a whole prompt window in
-                parallel, park its K/V pages into the target slot
-                (donated cache write via dynamic_update_slice), return
-                the prompt's first generated token. The phase split —
-                long prompts cost one bucketed dispatch instead of L
-                serial decode steps, and never reshape the shared
-                decode program.
+                LOGICAL position, scatter that position's K/V into a
+                host-chosen (page, offset) write cell, gather each
+                slot's attention window through per-cell
+                (page, offset) index arrays in logical token order,
+                attend under per-slot live masks, emit each slot's
+                greedy next token. Requests joining/leaving, prefix
+                pages being shared, copy-on-write forks, and ring wrap
+                past max_ctx are all pure DATA (the host page table) —
+                the compiled shape never changes, so arbitrary traffic
+                runs on one compile (pinned by trace counters).
+  chunk prefill ONE program per page_size chunk: process one
+                page-aligned slice of a prompt in parallel — causal
+                within the chunk, attending to the prior context
+                through the same gathered-cell indirection — and park
+                its K/V into one physical page. A prompt is a sequence
+                of chunk dispatches interleaved between decode steps,
+                so a long prompt never stalls resident generations,
+                and a prompt whose prefix pages already live in the
+                prefix trie skips its shared chunks entirely.
+  page copy     the copy-on-write primitive: duplicate one physical
+                page (all layers, K and V) inside the donated pool —
+                what a slot pays to diverge from a shared page.
 
-KV-cache layout (the tensor-layout discipline of Tensor Processing
-Primitives, arXiv 2104.05755): ONE preallocated buffer
-``[n_layers, 2, max_slots, n_heads, max_ctx, head_dim]`` — HEAD-MAJOR
-so both decode attention contractions batch over leading (slot, head)
-dims and contract the minor axis in place (the first slot-major
-attempt made XLA transpose 40% of program traffic per step — caught
-by prog-transpose-churn, documented in PERF.md), position pages
-contiguous per (slot, head) so a bucketed prefill fills
-``bucket_len/page_size`` whole pages in one slice write, head_dim
-innermost for lane alignment. Both programs DONATE the cache buffer:
-the update is in-place, the caller rebinds — program-lint's
-prog-unhonored-donation rule verifies the alias map actually honors
-it (a silent copy of this buffer per token is the regression the rule
-exists to catch; decode/prefill join the --programs representative
-set).
+Physical pool layout (the tensor-layout discipline of Tensor
+Processing Primitives, arXiv 2104.05755 — the page indirection is a
+hand-fused gather/scatter pair): ONE preallocated buffer
+``[n_layers, 2, n_pages, n_heads, page_size, head_dim]`` — page-major
+so one page id addresses every layer's K and V rows at once (one
+page-table entry per page, not per layer), HEAD-MAJOR within a page
+so gathered cells arrive [..., n_heads, cells, head_dim] and both
+attention contractions batch over leading (slot, head) dims (the
+first slot-major attempt made XLA transpose 40% of program traffic
+per step — caught by prog-transpose-churn, documented in PERF.md),
+head_dim innermost for lane alignment. Page 0 is SCRATCH: the write
+target for inactive/suppressed rows and the gather target for dead
+cells — never mapped live, and its (possibly garbage) bytes are
+zeroed out inside the attention primitives before any contraction.
+
+All three programs DONATE the pool: updates are in-place, the caller
+rebinds — program-lint's prog-unhonored-donation rule verifies the
+executable alias map actually honors it (a silent copy of this buffer
+per token is the regression the rule exists to catch; all three join
+the --programs representative set).
+
+Bitwise contract: the host passes cell index arrays in LOGICAL token
+order, so the engine under any page-table history (shared prefixes,
+CoW forks, ring wrap, eviction replay) presents the attention
+reduction with identical operand values in identical order to the
+sequential oracle's — the FP-associativity discipline that makes
+"bitwise equal to the oracle" achievable at all.
 
 Forensics / policy / MFU ride the exact StepProgram rails: programs
 live in the model's JitCache (record_trace inside traced bodies,
@@ -58,21 +79,41 @@ def next_pow2(n: int) -> int:
     return p
 
 
-class DecodeProgram:
-    """One CausalTransformer's compiled prefill/decode programs over a
-    fixed slot batch. Holds NO request state — serving/continuous.py's
-    DecodeEngine owns slots; this class owns shapes, compilation, and
-    the cache layout."""
+# physical page 0: scratch — write sink for inactive/suppressed rows,
+# gather target for dead cells (zeroed inside the attention kernels)
+SCRATCH_PAGE = 0
 
-    def __init__(self, model, max_slots: int = 8, page_size: int = 16):
+
+class DecodeProgram:
+    """One CausalTransformer's compiled chunk-prefill/decode/page-copy
+    programs over a fixed slot batch and a fixed physical page pool.
+    Holds NO request state — serving/continuous.py's DecodeEngine owns
+    slots, the page table, the prefix trie, and refcounts; this class
+    owns shapes, compilation, the pool layout, and the host-side
+    window-cell arithmetic both the engine and the oracle share."""
+
+    def __init__(self, model, max_slots: int = 8, page_size: int = 16,
+                 n_pages: Optional[int] = None):
         if page_size & (page_size - 1):
             raise ValueError(f"page_size must be a power of two "
-                             f"(page-aligned pow2 buckets): {page_size}")
+                             f"(page-aligned pow2 blocks): {page_size}")
         if model.params is None:
             model.init()
         self.model = model
         self.max_slots = int(max_slots)
         self.page_size = int(min(page_size, model.max_ctx))
+        # the attention window: every slot attends over at most
+        # max_ctx logical positions (sliding once positions wrap)
+        self.window = int(model.max_ctx)
+        self.pages_per_slot = self.window // self.page_size
+        if n_pages is None:
+            # equal HBM to a contiguous per-slot layout, + scratch
+            n_pages = self.max_slots * self.pages_per_slot + 1
+        self.n_pages = int(n_pages)
+        if self.n_pages < self.pages_per_slot + 1:
+            raise ValueError(
+                f"n_pages {self.n_pages} cannot hold one slot's "
+                f"window ({self.pages_per_slot} pages) + scratch")
         from deeplearning4j_tpu.nn.jit_cache import policy_name
 
         self.precision_policy = policy_name(
@@ -82,61 +123,89 @@ class DecodeProgram:
     @property
     def kv_shape(self) -> Tuple[int, ...]:
         m = self.model
-        return (m.n_layers, 2, self.max_slots, m.n_heads, m.max_ctx,
+        return (m.n_layers, 2, self.n_pages, m.n_heads, self.page_size,
                 m.head_dim)
 
     def init_kv(self):
-        """The preallocated paged KV cache (zeros; pages are always
-        overwritten before they are readable under the length masks)."""
+        """The preallocated physical page pool (zeros; cells are
+        zeroed in-kernel when dead and overwritten before they are
+        readable otherwise)."""
         import jax.numpy as jnp
 
         return jnp.zeros(self.kv_shape, jnp.float32)
 
-    def bucket(self, prompt_len: int) -> int:
-        """Pow2, page-aligned prefill bucket for a prompt length —
-        floor `page_size`, cap `max_ctx`. One compiled prefill program
-        serves every prompt in the bucket (shorter prompts pad; the
-        pad rows write only pages the decode masks keep unreadable)."""
+    def chunk_starts(self, prompt_len: int,
+                     from_token: int = 0) -> List[int]:
+        """The page-aligned chunk schedule for a prompt: one
+        `page_size` chunk dispatch per uncovered page, starting at the
+        first token the prefix trie did not cover (`from_token` is
+        always page-aligned — partial trie pages only match when they
+        cover the prompt's entire tail)."""
         if prompt_len < 1:
             raise ValueError("prompt must carry at least one token")
-        if prompt_len > self.model.max_ctx:
+        if prompt_len > self.window:
             raise ValueError(
-                f"prompt length {prompt_len} exceeds max_ctx "
-                f"{self.model.max_ctx}")
-        return min(self.model.max_ctx,
-                   max(self.page_size, next_pow2(prompt_len)))
+                f"prompt length {prompt_len} exceeds the attention "
+                f"window {self.window}")
+        return list(range(int(from_token), prompt_len, self.page_size))
+
+    def window_cells(self, table: Sequence[Optional[int]],
+                     pos: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Host-side virtual→physical translation: the per-cell
+        (page, offset) arrays for one slot's attention window at
+        logical position `pos`, in LOGICAL token order — cell j holds
+        position pos+1-live+j, where live = min(pos+1, window). Dead
+        cells (j >= live) point at the scratch page. `table` is the
+        slot's ring page table (pages_per_slot entries); entries for
+        live positions must be mapped. Shared by the engine and the
+        sequential oracle — the single definition of reduction order
+        the bitwise contract rests on."""
+        c, ps, p = self.window, self.page_size, self.pages_per_slot
+        cell_page = np.full(c, SCRATCH_PAGE, np.int32)
+        cell_off = np.zeros(c, np.int32)
+        live = min(pos + 1, c)
+        if live > 0:
+            qs = np.arange(pos + 1 - live, pos + 1)
+            rings = (qs // ps) % p
+            cell_page[:live] = [table[r] for r in rings]
+            cell_off[:live] = qs % ps
+        return cell_page, cell_off
 
     # ------------------------------------------------------- compile
     def decode_key(self):
-        return ("decode_step", self.max_slots, self.model.max_ctx)
+        return ("decode_step", self.max_slots, self.window,
+                self.n_pages)
 
-    def prefill_key(self, bucket_len: int):
-        return ("decode_prefill", int(bucket_len), self.max_slots,
-                self.model.max_ctx)
+    def chunk_key(self):
+        return ("decode_chunk_prefill", self.page_size, self.window,
+                self.n_pages)
+
+    def copy_key(self):
+        return ("decode_page_copy", self.n_pages)
+
+    def _program(self, key, builder):
+        cache = self.model._jit_cache
+        if key not in cache:
+            cache[key] = builder(str(key))
+            cache.register_policy(key, self.precision_policy)
+        return cache[key]
 
     def _decode_program(self):
-        cache = self.model._jit_cache
-        key = self.decode_key()
-        if key not in cache:
-            cache[key] = self._build_decode(str(key))
-            cache.register_policy(key, self.precision_policy)
-        return cache[key]
+        return self._program(self.decode_key(), self._build_decode)
 
-    def _prefill_program(self, bucket_len: int):
-        cache = self.model._jit_cache
-        key = self.prefill_key(bucket_len)
-        if key not in cache:
-            cache[key] = self._build_prefill(bucket_len, str(key))
-            cache.register_policy(key, self.precision_policy)
-        return cache[key]
+    def _chunk_program(self):
+        return self._program(self.chunk_key(), self._build_chunk)
+
+    def _copy_program(self):
+        return self._program(self.copy_key(), self._build_copy)
 
     def _build_decode(self, trace_key: str):
         """Compile the shared decode step. Per-slot independence is
         the load-bearing property: no op mixes slots (batched einsums,
-        per-row norms/softmax), so an active slot's emitted token is a
-        function of ITS tokens alone — the byte-identity-under-churn
-        contract tests/test_decode.py pins against the sequential
-        oracle."""
+        per-row norms/softmax, per-row gathers), so an active slot's
+        emitted token is a function of ITS cells alone — the
+        byte-identity-under-churn contract tests/test_decode.py pins
+        against the sequential oracle."""
         import jax
         import jax.numpy as jnp
 
@@ -149,23 +218,33 @@ class DecodeProgram:
 
         model = self.model
         n_heads = model.n_heads
+        max_ctx = model.max_ctx
         cache = model._jit_cache
-        # advanced-index triplet for the per-(slot, head) cache write:
-        # kv[li, io, s, h, positions[s]] = k[s, h] — the slot/head axes
-        # broadcast against the per-slot position vector
-        sidx = np.arange(self.max_slots)[:, None]
-        hidx = np.arange(model.n_heads)[None, :]
+        # broadcast head index for the [S, H, C, D] head-major gather
+        hidx = np.arange(n_heads)[None, :, None]
 
-        def decode_fn(params, kv, tokens, positions):
+        def decode_fn(params, pool, tokens, positions, cell_page,
+                      cell_off, write_page, write_off):
             cache.record_trace(trace_key)
-            x = params["tok_emb"][tokens] + params["pos_emb"][positions]
-            pos2 = positions[:, None]
+            # logical positions grow unbounded past max_ctx (ring
+            # wrap); the learned positional table wraps with them
+            x = (params["tok_emb"][tokens]
+                 + params["pos_emb"][positions % max_ctx])
+            live = jnp.minimum(positions + 1, self.window)
+            cp = cell_page[:, None, :]        # [S, 1, C] vs hidx
+            co = cell_off[:, None, :]
             for li, lp in enumerate(params["layers"]):
                 q, k, v = decode_qkv(lp, x, n_heads)
-                kv = kv.at[li, 0, sidx, hidx, pos2].set(k)
-                kv = kv.at[li, 1, sidx, hidx, pos2].set(v)
-                x = block_decode_finish(lp, x, q, kv[li, 0], kv[li, 1],
-                                        positions)
+                # scatter: pool[li, io, wp[s], h, wo[s]] = k[s, h] —
+                # the write cell is host-chosen (suppressed rows
+                # target scratch), advanced indices broadcast per slot
+                pool = pool.at[li, 0, write_page, :, write_off].set(k)
+                pool = pool.at[li, 1, write_page, :, write_off].set(v)
+                # gather: [S, H, C, D] head-major window cells in
+                # logical order — the virtual-memory read
+                kg = pool[li, 0][cp, hidx, co]
+                vg = pool[li, 1][cp, hidx, co]
+                x = block_decode_finish(lp, x, q, kg, vg, live)
             xf = layer_norm(x, params["lnf_g"], params["lnf_b"])
             logits = lm_logits(xf, params["tok_emb"])
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -174,98 +253,155 @@ class DecodeProgram:
             # the logits the step already materialized, so slot health
             # rides the same dispatch — a False row means this slot's
             # numerics are poison and its emitted token must not be
-            # trusted (DecodeEngine quarantines the slot and replays
-            # the request on a healthy one)
+            # trusted (DecodeEngine quarantines the slot AND its
+            # private pages, purges its trie entries, and replays the
+            # request on a healthy slot)
             ok = jnp.all(jnp.isfinite(logits), axis=-1)
-            return kv, nxt, ok
+            return pool, nxt, ok
 
         return jax.jit(decode_fn, donate_argnums=(1,))
 
-    def _build_prefill(self, bucket_len: int, trace_key: str):
-        """Compile one prompt bucket: window-parallel causal forward,
-        K/V pages parked into the target slot (slot and true length
-        are traced scalars — no recompile per slot), last real
-        position's greedy token returned. Pad rows beyond `length`
-        write pages the decode-side length masks never expose; they
-        are overwritten position-by-position as decoding advances."""
+    def _build_chunk(self, trace_key: str):
+        """Compile the chunk-prefill program: one page_size slice of a
+        prompt, causal within the chunk, prior context via gathered
+        cells, K/V parked into ONE physical page (`write_page` is a
+        traced scalar — no recompile per page). Pad rows beyond
+        `length` write page cells the live masks never expose; they
+        are overwritten cell-by-cell as decoding advances."""
         import jax
         import jax.numpy as jnp
 
         from deeplearning4j_tpu.nn.attention import (
-            block_prefill,
-            layer_norm,
-            lm_logits,
+            block_chunk_prefill,
+            decode_qkv,
         )
 
         model = self.model
         n_heads = model.n_heads
+        t = self.page_size
         cache = model._jit_cache
+        hidx = np.arange(n_heads)[:, None]   # [H, 1] vs [1, C] cells
+        offs = np.arange(t)                  # the page's cell offsets
 
-        def prefill_fn(params, kv, tokens, length, slot):
+        def chunk_fn(params, pool, tokens, start, cell_page, cell_off,
+                     write_page):
             cache.record_trace(trace_key)
             x = (params["tok_emb"][tokens]
-                 + params["pos_emb"][:bucket_len])
+                 + params["pos_emb"][start + jnp.arange(t)])
+            cp = cell_page[None, :]
+            co = cell_off[None, :]
             for li, lp in enumerate(params["layers"]):
-                x, k, v = block_prefill(lp, x, n_heads)
-                # window K/V arrive [T, H, Dh]; one small authored
-                # swap to the cache's head-major [H, T, Dh] pages —
-                # window-sized, paid once per JOIN (the big per-step
-                # cache tensors never transpose)
-                kt = jnp.swapaxes(k, 0, 1)[None, None, None]
-                vt = jnp.swapaxes(v, 0, 1)[None, None, None]
-                kv = jax.lax.dynamic_update_slice(
-                    kv, kt, (li, 0, slot, 0, 0, 0))
-                kv = jax.lax.dynamic_update_slice(
-                    kv, vt, (li, 1, slot, 0, 0, 0))
-            xf = layer_norm(x, params["lnf_g"], params["lnf_b"])
-            xl = jax.lax.dynamic_index_in_dim(xf, length - 1, axis=0,
-                                              keepdims=False)
-            logits = lm_logits(xl, params["tok_emb"])
-            nxt = jnp.argmax(logits).astype(jnp.int32)
-            return kv, nxt
+                # project + PARK the chunk's K/V before gathering the
+                # prior cells — the same scatter-then-gather order as
+                # the decode step, which is what lets XLA update the
+                # donated pool in place (a gather of the PRE-scatter
+                # pool forced two full-pool copies). Safe because the
+                # prior cells can never alias `write_page`: prefill
+                # never wraps (prompt <= window), so cell arrays point
+                # at earlier blocks' pages or scratch, and the
+                # advanced `offs` index lands [T, H, D] rows in the
+                # head-major page without an authored transpose.
+                q, k, v = decode_qkv(lp, x, n_heads)
+                pool = pool.at[li, 0, write_page, :, offs].set(k)
+                pool = pool.at[li, 1, write_page, :, offs].set(v)
+                kg = pool[li, 0][cp, hidx, co]      # [H, C, D]
+                vg = pool[li, 1][cp, hidx, co]
+                x = block_chunk_prefill(lp, x, n_heads, kg, vg, start,
+                                        qkv=(q, k, v))
+            return pool
 
-        return jax.jit(prefill_fn, donate_argnums=(1,))
+        return jax.jit(chunk_fn, donate_argnums=(1,))
+
+    def _build_copy(self, trace_key: str):
+        """Compile the copy-on-write primitive: duplicate one physical
+        page (every layer, K and V) inside the donated pool."""
+        import jax
+
+        cache = self.model._jit_cache
+        m = self.model
+        shape = (m.n_layers, 2, 1, m.n_heads, self.page_size,
+                 m.head_dim)
+
+        def copy_fn(pool, src, dst):
+            cache.record_trace(trace_key)
+            page = jax.lax.dynamic_slice(
+                pool, (0, 0, src, 0, 0, 0), shape)
+            return jax.lax.dynamic_update_slice(
+                pool, page, (0, 0, dst, 0, 0, 0))
+
+        return jax.jit(copy_fn, donate_argnums=(0,))
 
     # ----------------------------------------------------------- run
-    def step(self, kv, tokens, positions):
-        """One decode step over all slots. `tokens`/`positions` are
-        host [max_slots] int arrays (the engine's slot table); returns
+    def step(self, kv, tokens, positions, cell_page, cell_off,
+             write_page, write_off):
+        """One decode step over all slots. `tokens`/`positions`/
+        `write_page`/`write_off` are host [max_slots] int arrays and
+        `cell_page`/`cell_off` host [max_slots, window] int arrays
+        (the engine's translated page table); returns
         (new_kv, next_tokens, finite_ok) with `kv` donated — the
         caller MUST rebind. `finite_ok` is the per-slot finite-logits
         verdict ([max_slots] bool): a False row's token is numeric
-        poison. Inactive slots compute harmlessly (their writes land
-        on pages the masks keep dead until a prefill reclaims them);
-        the host decides whose outputs are real."""
+        poison. Inactive/suppressed rows write scratch and gather
+        scratch-backed dead cells (zeroed in-kernel) — the host
+        decides whose outputs are real."""
         import jax.numpy as jnp
 
         fn = self._decode_program()
         return fn(self.model.params, kv,
                   jnp.asarray(tokens, jnp.int32),
-                  jnp.asarray(positions, jnp.int32))
+                  jnp.asarray(positions, jnp.int32),
+                  jnp.asarray(cell_page, jnp.int32),
+                  jnp.asarray(cell_off, jnp.int32),
+                  jnp.asarray(write_page, jnp.int32),
+                  jnp.asarray(write_off, jnp.int32))
 
-    def prefill(self, kv, prompt: Sequence[int], slot: int):
-        """Fill `slot`'s KV pages from a prompt and return
-        (new_kv, first_generated_token). Pads the prompt to its pow2
-        page-aligned bucket; `kv` is donated — rebind."""
+    def prefill_chunk(self, kv, chunk: Sequence[int], start: int,
+                      cell_page, cell_off, write_page: int):
+        """Prefill one page-aligned prompt chunk (positions
+        start..start+len(chunk)-1, padded to page_size) into physical
+        page `write_page`, attending to the prior context through
+        `cell_page`/`cell_off` ([window] arrays, cells >= start dead).
+        `kv` is donated — rebind."""
         import jax.numpy as jnp
 
-        prompt = np.asarray(prompt, np.int32).ravel()
-        b = self.bucket(len(prompt))
-        padded = np.zeros(b, np.int32)
-        padded[:len(prompt)] = prompt
-        fn = self._prefill_program(b)
+        chunk = np.asarray(chunk, np.int32).ravel()
+        padded = np.zeros(self.page_size, np.int32)
+        padded[:len(chunk)] = chunk
+        fn = self._chunk_program()
         return fn(self.model.params, kv, jnp.asarray(padded),
-                  jnp.int32(len(prompt)), jnp.int32(slot))
+                  jnp.int32(start),
+                  jnp.asarray(cell_page, jnp.int32),
+                  jnp.asarray(cell_off, jnp.int32),
+                  jnp.int32(write_page))
+
+    def copy_page(self, kv, src: int, dst: int):
+        """Copy-on-write: duplicate physical page `src` into `dst`
+        (all layers, K and V). `kv` is donated — rebind."""
+        import jax.numpy as jnp
+
+        fn = self._copy_program()
+        return fn(kv, jnp.int32(src), jnp.int32(dst))
 
     def warmup(self, kv, buckets: Sequence[int] = ()):
-        """Compile the decode step + the given prefill buckets up
-        front (serving warmup discipline: compiles happen before
-        traffic, the trace counters pin that none happen after).
-        Returns the (donated-through) cache buffer."""
-        for b in (buckets or (self.page_size,)):
-            kv, _ = self.prefill(kv, [0] * int(b), 0)
-        kv, _, _ = self.step(kv, np.zeros(self.max_slots, np.int32),
-                             np.zeros(self.max_slots, np.int32))
+        """Compile all three programs up front (serving warmup
+        discipline: compiles happen before traffic, the trace counters
+        pin that none happen after). `buckets` is accepted for
+        call-site compatibility and ignored — chunked prefill replaced
+        the per-bucket prefill family with ONE chunk shape. Returns
+        the (donated-through) pool buffer."""
+        del buckets
+        kv = self.copy_page(kv, SCRATCH_PAGE, SCRATCH_PAGE)
+        cp, co = self.window_cells([SCRATCH_PAGE] * self.pages_per_slot,
+                                   -1)
+        kv = self.prefill_chunk(kv, [0] * self.page_size, 0, cp, co,
+                                SCRATCH_PAGE)
+        s, c = self.max_slots, self.window
+        kv, _, _ = self.step(kv, np.zeros(s, np.int32),
+                             np.zeros(s, np.int32),
+                             np.zeros((s, c), np.int32),
+                             np.zeros((s, c), np.int32),
+                             np.zeros(s, np.int32),
+                             np.zeros(s, np.int32))
         return kv
 
     def trace_stats(self) -> dict:
@@ -277,13 +413,16 @@ class DecodeProgram:
 
     # ------------------------------------------------------------ lint
     def lint_records(self, buckets: Sequence[int] = ()) -> List:
-        """ProgramRecords for the decode step and prefill bucket(s) —
-        built through the same cache paths `step`/`prefill` use (policy
-        registered), traced/lowered by the lint but never executed.
-        Donation on the [n_layers, 2, max_slots, max_ctx, ...] cache
-        is the declared fact prog-unhonored-donation verifies: a
-        silently-copied cache would double decode memory AND pay a
-        full-cache copy per token."""
+        """ProgramRecords for the decode step, the chunk prefill, and
+        the page copy — built through the same cache paths the engine
+        uses (policy registered), traced/lowered by the lint but never
+        executed. Donation on the [n_layers, 2, n_pages, n_heads,
+        page_size, head_dim] pool is DECLARED on every record
+        (donate_argnums) so prog-unhonored-donation verifies the
+        executable alias map genuinely aliases the pool in place — a
+        silently-copied pool would double decode memory AND pay a
+        full-pool copy per token/chunk."""
+        del buckets
         import jax.numpy as jnp
 
         from deeplearning4j_tpu.analysis.program_lint import (
@@ -292,48 +431,64 @@ class DecodeProgram:
 
         model = self.model
         kv = self.init_kv()
+        s, c = self.max_slots, self.window
         source = "deeplearning4j_tpu/engine/decode_program.py"
-        records = [ProgramRecord(
-            name=f"decode_step_s{self.max_slots}",
-            fn=getattr(self._decode_program(), "__wrapped__",
-                       self._decode_program()),
-            example_args=(model.params, kv,
-                          jnp.zeros(self.max_slots, jnp.int32),
-                          jnp.zeros(self.max_slots, jnp.int32)),
-            precision_policy=self.precision_policy, source=source,
-            consumed_outputs=(0, 1, 2))]
-        for b in (buckets or (self.page_size,)):
-            b = int(b)
-            fn = self._prefill_program(b)
-            records.append(ProgramRecord(
-                name=f"decode_prefill_b{b}",
-                fn=getattr(fn, "__wrapped__", fn),
-                example_args=(model.params, kv,
-                              jnp.zeros(b, jnp.int32), jnp.int32(b),
-                              jnp.int32(0)),
+        zs = jnp.zeros(s, jnp.int32)
+        zc = jnp.zeros(c, jnp.int32)
+        step_fn = self._decode_program()
+        chunk_fn = self._chunk_program()
+        copy_fn = self._copy_program()
+        return [
+            ProgramRecord(
+                name=f"decode_step_s{s}",
+                fn=getattr(step_fn, "__wrapped__", step_fn),
+                example_args=(model.params, kv, zs, zs,
+                              jnp.zeros((s, c), jnp.int32),
+                              jnp.zeros((s, c), jnp.int32), zs, zs),
+                donate_argnums=(1,),
                 precision_policy=self.precision_policy, source=source,
-                consumed_outputs=(0, 1)))
-        return records
+                consumed_outputs=(0, 1, 2)),
+            ProgramRecord(
+                name=f"decode_prefill_c{self.page_size}",
+                fn=getattr(chunk_fn, "__wrapped__", chunk_fn),
+                example_args=(model.params, kv,
+                              jnp.zeros(self.page_size, jnp.int32),
+                              jnp.int32(0), zc, zc, jnp.int32(1)),
+                donate_argnums=(1,),
+                precision_policy=self.precision_policy, source=source,
+                consumed_outputs=(0,)),
+            ProgramRecord(
+                name="decode_page_copy",
+                fn=getattr(copy_fn, "__wrapped__", copy_fn),
+                example_args=(kv, jnp.int32(1), jnp.int32(2)),
+                donate_argnums=(0,),
+                precision_policy=self.precision_policy, source=source,
+                consumed_outputs=(0,)),
+        ]
 
     # ------------------------------------------------------------ perf
     def register_perf(self, cost_model, bucket_len: Optional[int] = None):
-        """Attach XLA cost-model entries for the decode step (and a
-        prefill bucket when given) to `cost_model` — MFU gauges +
-        forensics cost digests, the StepProgram.register_perf
-        discipline. Best-effort: returns the decode entry or None."""
+        """Attach XLA cost-model entries for the decode step (and the
+        chunk-prefill program when `bucket_len` is given) to
+        `cost_model` — MFU gauges + forensics cost digests, the
+        StepProgram.register_perf discipline. Best-effort: returns the
+        decode entry or None."""
         import jax.numpy as jnp
 
         cache = self.model._jit_cache
         kv = self.init_kv()
+        s, c = self.max_slots, self.window
+        zs = jnp.zeros(s, jnp.int32)
         entry = cost_model.register_jit_entry(
-            cache, self.decode_key(), self.model.params, kv,
-            jnp.zeros(self.max_slots, jnp.int32),
-            jnp.zeros(self.max_slots, jnp.int32))
+            cache, self.decode_key(), self.model.params, kv, zs, zs,
+            jnp.zeros((s, c), jnp.int32),
+            jnp.zeros((s, c), jnp.int32), zs, zs)
         if bucket_len:
-            b = int(bucket_len)
-            self._prefill_program(b)
+            self._chunk_program()
             cost_model.register_jit_entry(
-                cache, self.prefill_key(b), self.model.params,
-                self.init_kv(), jnp.zeros(b, jnp.int32), jnp.int32(b),
-                jnp.int32(0))
+                cache, self.chunk_key(), self.model.params,
+                self.init_kv(),
+                jnp.zeros(self.page_size, jnp.int32), jnp.int32(0),
+                jnp.zeros(c, jnp.int32), jnp.zeros(c, jnp.int32),
+                jnp.int32(1))
         return entry

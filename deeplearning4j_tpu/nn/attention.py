@@ -1,31 +1,46 @@
-"""KV-aware causal self-attention primitives.
+"""KV-aware causal self-attention primitives over a PAGED cache.
 
-The decode-serving arc (ROADMAP item 3a) needs a transformer forward
+The decode-serving arc (ROADMAP item 2) needs a transformer forward
 that exists in TWO compiled shapes over ONE set of weights:
 
-  prefill    a whole prompt window [T, d_model] processed in parallel
-             under a causal mask, emitting the window's K/V tensors so
-             the caller can park them in a slot's KV-cache pages;
+  chunk prefill   one page_size-aligned slice [T, d_model] of a prompt
+             processed in parallel: causal within the chunk, attending
+             to the PRIOR context through gathered page cells, emitting
+             the chunk's K/V so the caller parks them in a physical
+             page. Chunks interleave with decode steps, so a long
+             prompt never stalls resident generations.
   decode     ONE new position per slot, batched over the engine's
-             [max_slots] axis, attending against the preallocated
-             per-slot cache with a per-slot length mask — the shape
-             that lets thousands of streams share one compiled step.
+             [max_slots] axis, attending against page cells GATHERED
+             in logical token order — the per-cell (page, offset)
+             indirection that makes the cache a virtual address space:
+             shared prefix pages, copy-on-write forks, and ring wrap
+             past max_ctx are all host page-table edits, never a new
+             compiled shape.
 
 Both build from the same per-layer parameter dict (see
 zoo/decoder.CausalTransformer), so the math of a position is defined
-once; engine/decode_program.py owns where K/V land in the cache.
+once; engine/decode_program.py owns where K/V land in the page pool.
 
 Layout discipline (Tensor Processing Primitives, arXiv 2104.05755):
 head_dim rides innermost everywhere (the contraction axis of both
-attention matmuls stays in the minor/lane dimension), and the DECODE
-cache is head-major [slots, n_heads, max_ctx, head_dim] so (slot,
-head) are leading batch dims of both cache contractions — XLA
-contracts in place instead of materializing a transposed cache copy
-per step (the transpose-churn finding the program lint raised against
-the first slot-major layout — PERF.md "Decode program layout").
-Masking uses a large finite negative instead of -inf so never-written
-cache positions (whatever bytes they hold) can't poison a softmax
-with inf-inf=NaN.
+attention matmuls stays in the minor/lane dimension), and gathered
+cells arrive HEAD-MAJOR [..., n_heads, cells, head_dim] so both cache
+contractions keep (slot, head) as leading batch dims — XLA contracts
+in place instead of materializing a transposed cache copy per step
+(the transpose-churn finding the program lint raised against the
+first slot-major layout — PERF.md "Decode program layout").
+
+Bitwise discipline: attention is commutative but NOT associative over
+keys, so the engine and the sequential oracle must present identical
+operand values in an identical reduction order. Gathering cells in
+LOGICAL token order (cell j = j-th oldest position in the window) is
+that mechanism — a wrapped ring, a shared prefix page, and a fresh
+contiguous fill all reduce over the same [cells] axis in the same
+order. Dead cells are zeroed BEFORE the score contraction (not just
+masked after): a dead cell points at the shared scratch page, whose
+bytes other slots scribble, and 0·garbage is the only value that can
+never leak — exp(MASK_VALUE - max) underflows the weight to exactly
+0.0, and the zeroed value keeps 0·NaN out of the weighted sum.
 
 Everything here is pure jax on traced values — no host syncs, no
 Python branching on data — so the functions compose into donated,
@@ -60,43 +75,63 @@ def qkv_heads(lp: dict, x, n_heads: int):
     return split(lp["wq"]), split(lp["wk"]), split(lp["wv"])
 
 
-def causal_window_attention(q, k, v):
-    """Full-window causal attention (the PREFILL shape): q/k/v are
-    [T, n_heads, head_dim]; position t attends to positions <= t of
-    the same window. Returns [T, n_heads, head_dim]."""
-    import jax.numpy as jnp
-
-    t = q.shape[0]
-    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], q.dtype))
-    scores = jnp.einsum("thd,uhd->htu", q, k) * scale     # [H, T, T]
-    causal = jnp.tril(jnp.ones((t, t), bool))
-    scores = jnp.where(causal[None, :, :], scores, MASK_VALUE)
-    w = _softmax(scores)
-    return jnp.einsum("htu,uhd->thd", w, v)
-
-
-def cached_decode_attention(q, k_cache, v_cache, positions):
-    """Single-position attention against the slot cache (the DECODE
-    shape): `q` is [S, n_heads, head_dim] (one new position per slot),
-    `k_cache`/`v_cache` are HEAD-MAJOR [S, n_heads, max_ctx, head_dim]
-    with the new position's K/V already written at index
-    `positions[s]`, and each slot attends to its own cache entries
-    0..positions[s] — the per-slot length mask that makes slot
-    join/leave a pure data change, never a shape change. Head-major
-    cache layout is load-bearing: BOTH contractions below run with
-    (slot, head) as leading batch dims and the contraction axis minor,
-    so XLA never materializes a transposed copy of the cache (the 40%
+def paged_decode_attention(q, k_cells, v_cells, live):
+    """Single-position attention against GATHERED page cells (the
+    DECODE shape): `q` is [S, n_heads, head_dim] (one new position per
+    slot), `k_cells`/`v_cells` are HEAD-MAJOR
+    [S, n_heads, cells, head_dim] — the slot's window gathered from
+    the physical page pool in LOGICAL token order (cell j = j-th
+    oldest live position), with the new position's K/V already written
+    at cell live[s]-1. `live[s]` counts the slot's readable cells;
+    cells beyond it point at the scratch page and are zeroed before
+    the score contraction (see the module docstring). Head-major cell
+    layout is load-bearing: BOTH contractions run with (slot, head) as
+    leading batch dims and the contraction axis minor, so XLA never
+    materializes a transposed copy of the gathered cells (the 40%
     transpose-churn the program lint flagged on the first slot-major
     attempt — PERF.md). Returns [S, n_heads, head_dim]."""
     import jax.numpy as jnp
 
-    c = k_cache.shape[2]
+    c = k_cells.shape[2]
     scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], q.dtype))
-    scores = jnp.einsum("shd,shcd->shc", q, k_cache) * scale
-    live = jnp.arange(c)[None, :] <= positions[:, None]   # [S, C]
-    scores = jnp.where(live[:, None, :], scores, MASK_VALUE)
+    mask = jnp.arange(c)[None, :] < live[:, None]          # [S, C]
+    m4 = mask[:, None, :, None]
+    k_cells = jnp.where(m4, k_cells, 0.0)
+    v_cells = jnp.where(m4, v_cells, 0.0)
+    scores = jnp.einsum("shd,shcd->shc", q, k_cells) * scale
+    scores = jnp.where(mask[:, None, :], scores, MASK_VALUE)
     w = _softmax(scores)
-    return jnp.einsum("shc,shcd->shd", w, v_cache)
+    return jnp.einsum("shc,shcd->shd", w, v_cells)
+
+
+def chunk_prefill_attention(q, k, v, k_cells, v_cells, n_prior):
+    """One prompt chunk attending jointly to its PRIOR context and to
+    itself (the CHUNK-PREFILL shape): `q`/`k`/`v` are [T, n_heads,
+    head_dim] for chunk positions n_prior..n_prior+T-1; `k_cells`/
+    `v_cells` are HEAD-MAJOR [n_heads, cells, head_dim] — the already-
+    prefilled positions 0..n_prior-1 gathered from their pages in
+    logical order (cells >= n_prior are scratch: zeroed + masked).
+    ONE softmax spans [prior cells ; chunk] so the reduction order is
+    fixed regardless of how the prior pages were produced — computed
+    by an earlier chunk, or mapped read-only from the prefix trie.
+    Returns [T, n_heads, head_dim]."""
+    import jax.numpy as jnp
+
+    t = q.shape[0]
+    c = k_cells.shape[1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], q.dtype))
+    prior = jnp.arange(c) < n_prior                        # [C]
+    m3 = prior[None, :, None]
+    k_cells = jnp.where(m3, k_cells, 0.0)
+    v_cells = jnp.where(m3, v_cells, 0.0)
+    sp = jnp.einsum("thd,hcd->htc", q, k_cells) * scale    # [H, T, C]
+    sp = jnp.where(prior[None, None, :], sp, MASK_VALUE)
+    si = jnp.einsum("thd,uhd->htu", q, k) * scale          # [H, T, T]
+    causal = jnp.tril(jnp.ones((t, t), bool))
+    si = jnp.where(causal[None, :, :], si, MASK_VALUE)
+    w = _softmax(jnp.concatenate([sp, si], axis=-1))
+    return (jnp.einsum("htc,hcd->thd", w[..., :c], v_cells)
+            + jnp.einsum("htu,uhd->thd", w[..., c:], v))
 
 
 def _softmax(scores):
@@ -115,38 +150,44 @@ def mlp_block(lp: dict, x):
     return h @ lp["w2"] + lp["b2"]
 
 
-def block_prefill(lp: dict, x, n_heads: int):
-    """One decoder block over a whole window: x [T, d_model] ->
-    (x', k, v) where k/v are the window's cache-ready
-    [T, n_heads, head_dim] tensors (pre-attention projections of the
-    ln1 stream — exactly what the decode shape recomputes per
-    position, so a prefilled page and a decoded page hold the same
-    quantity)."""
-    h = layer_norm(x, lp["ln1_g"], lp["ln1_b"])
-    q, k, v = qkv_heads(lp, h, n_heads)
-    att = causal_window_attention(q, k, v)
+def block_chunk_prefill(lp: dict, x, n_heads: int, k_cells, v_cells,
+                        n_prior, qkv=None):
+    """One decoder block over a prompt CHUNK: x [T, d_model] -> x'.
+    The chunk's q/k/v are pre-attention projections of the ln1 stream
+    — exactly what the decode shape recomputes per position, so a
+    chunk-prefilled cell and a decoded cell hold the same quantity.
+    The caller usually passes `qkv` precomputed via `decode_qkv` (it
+    parks k/v into a physical page BEFORE attention — the
+    scatter-then-gather order that keeps the pool update in place);
+    `k_cells`/`v_cells`/`n_prior` carry the prior context per
+    `chunk_prefill_attention`."""
+    if qkv is None:
+        h = layer_norm(x, lp["ln1_g"], lp["ln1_b"])
+        qkv = qkv_heads(lp, h, n_heads)
+    q, k, v = qkv
+    att = chunk_prefill_attention(q, k, v, k_cells, v_cells, n_prior)
     x = x + _merge_heads(att) @ lp["wo"]
     x = x + mlp_block(lp, layer_norm(x, lp["ln2_g"], lp["ln2_b"]))
-    return x, k, v
+    return x
 
 
 def decode_qkv(lp: dict, x, n_heads: int):
     """First half of a decode-shape block: the current position's
     q/k/v projections off the ln1 stream — the same quantities
-    block_prefill parks in the cache, so a prefilled page and a
-    decoded page hold identical values. The caller writes k/v into
-    the slot's cache pages BEFORE calling `block_decode_finish` (the
+    block_chunk_prefill parks in pages, so a prefilled cell and a
+    decoded cell hold identical values. The caller writes k/v into
+    the slot's write cell BEFORE calling `block_decode_finish` (the
     position must attend to itself)."""
     h = layer_norm(x, lp["ln1_g"], lp["ln1_b"])
     return qkv_heads(lp, h, n_heads)
 
 
-def block_decode_finish(lp: dict, x, q, k_cache, v_cache, positions):
+def block_decode_finish(lp: dict, x, q, k_cells, v_cells, live):
     """Second half of a decode-shape block: attend `q` [S, H, Dh]
-    against the slot caches [S, max_ctx, H, Dh] (current position's
-    K/V already written at `positions[s]`) and run the residual +
-    feed-forward tail. Returns x' [S, d_model]."""
-    att = cached_decode_attention(q, k_cache, v_cache, positions)
+    against the gathered window cells [S, H, cells, Dh] (current
+    position's K/V already written at cell live[s]-1) and run the
+    residual + feed-forward tail. Returns x' [S, d_model]."""
+    att = paged_decode_attention(q, k_cells, v_cells, live)
     x = x + _merge_heads(att) @ lp["wo"]
     x = x + mlp_block(lp, layer_norm(x, lp["ln2_g"], lp["ln2_b"]))
     return x

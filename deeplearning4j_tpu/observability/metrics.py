@@ -105,6 +105,12 @@ REGISTERED_METRICS = frozenset({
     "dl4j_decode_tokens_per_s",
     "dl4j_decode_prefill_seconds",
     "dl4j_decode_slot_evictions_total",
+    # paged KV virtual memory (prefix trie / chunked prefill / ring wrap)
+    "dl4j_decode_prefix_hits_total",
+    "dl4j_decode_prefix_pages_shared",
+    "dl4j_decode_pages_free",
+    "dl4j_decode_prefill_chunks_total",
+    "dl4j_decode_ctx_wraps_total",
     # decode durability (quarantine / migration / watchdog / deadlines)
     "dl4j_decode_slot_quarantines_total",
     "dl4j_decode_migrations_total",

@@ -9,11 +9,13 @@ stream. The DecodeEngine instead runs ONE compiled decode step over a
 fixed `max_slots` batch (engine/decode_program.DecodeProgram) and
 treats request lifecycle as pure data:
 
-  join    an admitted request claims a free slot at ANY step: one
-          bucketed prefill dispatch parks its prompt's K/V pages and
-          yields its first token, then the slot rides the shared
-          decode loop — running streams never wait out a long prompt
-          token-by-token, and nothing recompiles;
+  join    an admitted request claims a free slot at ANY step: its
+          prompt prefills in page_size CHUNKS, one chunk dispatch
+          interleaved per engine step, so a long prompt never stalls
+          resident generations; once its K/V pages are in, a uniform
+          first-token decode step (write suppressed — the cells are
+          already written) emits its first token and the slot rides
+          the shared decode loop. Nothing recompiles;
   leave   EOS or max-tokens frees the slot between two steps; the
           program never learns a request ended (per-slot active masks
           are host state — the compiled shape is invariant);
@@ -25,6 +27,32 @@ treats request lifecycle as pure data:
           slot held (same programs, same inputs), so the continuation
           is byte-identical to a never-evicted run — the property
           `sequential_decode` oracles pin.
+
+Paged KV virtual memory (this file owns the HOST half; the compiled
+half is engine/decode_program.py): each slot holds a ring page table
+over a shared refcounted physical pool (PagePool) —
+
+  share   a PrefixTrie caches prompt pages by page-aligned token
+          blocks; N requests with a common prefix MAP the same
+          read-only pages (one pool ref per referent), and the Kth
+          identical prompt skips prefill entirely. Sharing is bitwise
+          safe because a shared page holds exactly the bytes its
+          unshared twin would have computed, and the uniform
+          first-token step runs identically either way;
+  CoW     the first generation write into a page something else still
+          references (a trie entry, a prefix twin) copies it first
+          (`decode_page_copy`) — divergence costs one page copy, not
+          correctness;
+  wrap    logical positions run PAST the attention window: the ring
+          table recycles the slot's own oldest page (sliding-window
+          attention), so long generations never die at max_ctx;
+  reclaim under pool pressure the engine LRU-evicts trie-only cached
+          pages, then evicts resident requests (replay makes that
+          safe); page quarantine mirrors slot quarantine — a poisoned
+          slot's PRIVATE pages are written off, its trie
+          registrations purged, while genuinely shared pages merely
+          lose a reference (the poison only ever wrote private
+          cells).
 
 Byte-identity contract: greedy decoding + per-slot independence of the
 compiled step mean every emitted token is a deterministic function of
@@ -204,6 +232,239 @@ class GenerationHandle:
             self._cond.notify_all()
 
 
+class PagePool:
+    """Refcounted allocator over the physical page axis of the
+    DecodeProgram pool. Page 0 is scratch (never allocated). A page is
+    free iff its refcount is 0 and it is not quarantined; referents
+    are slot page-table entries and prefix-trie registrations — one
+    retain per referent, exact by construction (the refcount-exactness
+    test drains the engine and audits this)."""
+
+    def __init__(self, n_pages: int):
+        self.n_pages = int(n_pages)
+        self.ref = np.zeros(self.n_pages, np.int64)
+        self._free: deque = deque(range(1, self.n_pages))
+        # pages written by a quarantined slot: their bytes may be
+        # numeric poison — written off, never freed (the page-granular
+        # analog of never reusing a quarantined slot)
+        self.quarantined: set = set()
+
+    def alloc(self) -> Optional[int]:
+        if not self._free:
+            return None
+        p = self._free.popleft()
+        self.ref[p] = 1
+        return p
+
+    def retain(self, page: int) -> None:
+        self.ref[page] += 1
+
+    def release(self, page: int) -> None:
+        self.ref[page] -= 1
+        if self.ref[page] == 0 and page not in self.quarantined:
+            self._free.append(page)
+
+    def quarantine(self, page: int) -> None:
+        """Drop one referent's ref AND write the page off: when the
+        last referent lets go it parks in the quarantined set instead
+        of the free list."""
+        self.quarantined.add(page)
+        self.release(page)
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def shared_count(self) -> int:
+        return int(np.sum(self.ref > 1))
+
+    def audit(self) -> Dict:
+        """Exact page accounting (the no-leak/no-double-free pin):
+        every non-scratch page is free, referenced, or quarantined —
+        `leaked` must be 0 and no page may appear twice."""
+        free = list(self._free)
+        referenced = int(np.sum(self.ref[1:] > 0))
+        quarantined_parked = sum(1 for p in self.quarantined
+                                 if self.ref[p] == 0)
+        usable = self.n_pages - 1
+        return {
+            "total": usable,
+            "free": len(free),
+            "referenced": referenced,
+            "quarantined": quarantined_parked,
+            "leaked": usable - len(free) - referenced
+                      - quarantined_parked,
+            "double_freed": len(free) != len(set(free))
+                            or any(self.ref[p] != 0 for p in free),
+        }
+
+
+class _TrieNode:
+    __slots__ = ("children", "partials")
+
+    def __init__(self):
+        # full page_size block -> (physical page, child node)
+        self.children: Dict[Tuple[int, ...], Tuple[int, "_TrieNode"]] = {}
+        # partial tail block (< page_size tokens) -> physical page
+        self.partials: Dict[Tuple[int, ...], int] = {}
+
+
+class PrefixTrie:
+    """Shared-prefix page cache: a trie over page-aligned token
+    blocks, content-addressed (dict hashing of the block tuple chains
+    the parent path, so equal pages are equal prompt prefixes — no
+    collision risk, vLLM-style block hashing with exact keys). A node
+    maps one full `page_size` block to the physical page holding its
+    K/V; `partials` additionally cache a prompt's sub-page tail so the
+    Kth IDENTICAL prompt skips prefill entirely. The trie holds one
+    pool ref per registered page; pages it holds alone (ref==1) are
+    reclaimable cache, evicted LRU when the pool runs dry."""
+
+    def __init__(self, page_size: int):
+        self.page_size = int(page_size)
+        self.root = _TrieNode()
+        self._tick = 0
+        self._last_used: Dict[int, int] = {}
+        # page -> (owning node, "child"|"partial", key) for removal
+        self._where: Dict[int, Tuple[_TrieNode, str, tuple]] = {}
+
+    def __len__(self) -> int:
+        return len(self._where)
+
+    def _touch(self, page: int) -> None:
+        self._tick += 1
+        self._last_used[page] = self._tick
+
+    def match(self, prompt: Sequence[int]
+              ) -> Tuple[List[int], int]:
+        """Walk the prompt's block chain: returns (pages, covered) —
+        the physical pages holding its longest cached prefix and how
+        many tokens they cover. A partial (sub-page) entry only
+        matches when it covers the prompt's ENTIRE tail, so coverage
+        is always page-aligned or total."""
+        ps = self.page_size
+        node, pages, i = self.root, [], 0
+        n = len(prompt)
+        while i + ps <= n:
+            ent = node.children.get(tuple(prompt[i:i + ps]))
+            if ent is None:
+                break
+            page, node = ent
+            pages.append(page)
+            self._touch(page)
+            i += ps
+        if 0 < n - i < ps:
+            page = node.partials.get(tuple(prompt[i:]))
+            if page is not None:
+                pages.append(page)
+                self._touch(page)
+                return pages, n
+        return pages, i
+
+    def register(self, prompt: Sequence[int],
+                 table: Sequence[Optional[int]],
+                 pool: PagePool) -> List[int]:
+        """Insert the prompt's freshly computed pages (ring `table`
+        entries — during prefill block b lives at table[b]) into the
+        trie, one pool retain per inserted page. Blocks already cached
+        (this slot's own trie hits, or a concurrent twin that
+        registered first) are left untouched. Returns the pages THIS
+        call inserted — the slot keeps them for poison purge."""
+        ps = self.page_size
+        node, i, b = self.root, 0, 0
+        inserted: List[int] = []
+        n = len(prompt)
+        while i + ps <= n:
+            blk = tuple(prompt[i:i + ps])
+            ent = node.children.get(blk)
+            if ent is None:
+                page = table[b]
+                ent = (page, _TrieNode())
+                node.children[blk] = ent
+                pool.retain(page)
+                self._where[page] = (node, "child", blk)
+                self._touch(page)
+                inserted.append(page)
+            node = ent[1]
+            i += ps
+            b += 1
+        tail = tuple(prompt[i:])
+        if tail and tail not in node.partials:
+            page = table[b]
+            node.partials[tail] = page
+            pool.retain(page)
+            self._where[page] = (node, "partial", tail)
+            self._touch(page)
+            inserted.append(page)
+        return inserted
+
+    def _drop(self, page: int, pool: PagePool,
+              quarantine: bool) -> None:
+        loc = self._where.pop(page, None)
+        self._last_used.pop(page, None)
+        if loc is None:
+            return
+        node, kind, key = loc
+        if kind == "partial":
+            node.partials.pop(key, None)
+            (pool.quarantine if quarantine else pool.release)(page)
+            return
+        ent = node.children.pop(key, None)
+        (pool.quarantine if quarantine else pool.release)(page)
+        if ent is not None:
+            # removing a middle block strands its subtree (a child
+            # chain is only reachable through its parent) — release
+            # every descendant registration too, or their refs leak
+            self._drop_subtree(ent[1], pool, quarantine)
+
+    def _drop_subtree(self, node: _TrieNode, pool: PagePool,
+                      quarantine: bool) -> None:
+        for key, page in list(node.partials.items()):
+            node.partials.pop(key, None)
+            self._where.pop(page, None)
+            self._last_used.pop(page, None)
+            (pool.quarantine if quarantine else pool.release)(page)
+        for key, (page, child) in list(node.children.items()):
+            node.children.pop(key, None)
+            self._where.pop(page, None)
+            self._last_used.pop(page, None)
+            (pool.quarantine if quarantine else pool.release)(page)
+            self._drop_subtree(child, pool, quarantine)
+
+    def purge(self, pages: Sequence[int], pool: PagePool) -> None:
+        """Poison purge: a quarantined slot's registrations must never
+        be served to a later prefix hit — remove them (and any chains
+        through them), quarantining pages the trie held alone."""
+        for p in pages:
+            self._drop(p, pool, quarantine=True)
+
+    def evict_lru(self, pool: PagePool) -> bool:
+        """Reclaim ONE least-recently-used trie-only page (ref==1 —
+        no slot maps it) whose entry is a leaf (evicting a middle
+        block would strand the cached chain below it). Returns True if
+        a page went back to the free list."""
+        best, best_tick = None, None
+        for page, loc in self._where.items():
+            if pool.ref[page] != 1:
+                continue
+            node, kind, key = loc
+            if kind == "child":
+                child = node.children[key][1]
+                if child.children or child.partials:
+                    continue
+            tick = self._last_used.get(page, 0)
+            if best_tick is None or tick < best_tick:
+                best, best_tick = page, tick
+        if best is None:
+            return False
+        self._drop(best, pool, quarantine=False)
+        return True
+
+    def clear(self, pool: PagePool) -> None:
+        """Release every registration (disable/reset path)."""
+        self._drop_subtree(self.root, pool, quarantine=False)
+
+
 class DecodeEngine:
     """Slot-based continuous-batching server for one decoder model.
 
@@ -224,7 +485,9 @@ class DecodeEngine:
                  program=None, max_prefills_per_step: int = 1,
                  watchdog_timeout_s: Optional[float] = None,
                  max_engine_restarts: int = 3,
-                 poison_strike_limit: int = 2):
+                 poison_strike_limit: int = 2,
+                 n_pages: Optional[int] = None,
+                 prefix_cache: bool = True):
         from deeplearning4j_tpu.engine.decode_program import (
             DecodeProgram,
         )
@@ -234,9 +497,11 @@ class DecodeEngine:
                 raise ValueError("DecodeEngine needs a model or a "
                                  "DecodeProgram")
             program = DecodeProgram(model, max_slots=max_slots,
-                                    page_size=page_size)
+                                    page_size=page_size,
+                                    n_pages=n_pages)
         self.program = program
         self.max_slots = program.max_slots
+        self.prefix_cache = bool(prefix_cache)
         self.admission = admission
         self.model_name = model_name
         self.queue_limit = (int(queue_limit) if queue_limit is not None
@@ -256,6 +521,27 @@ class DecodeEngine:
         self._quarantined = np.zeros(s, bool)
         self._slot_req: List[Optional[GenerationHandle]] = [None] * s
         self._slot_replay: List[Optional[deque]] = [None] * s
+        # ---- paged KV virtual memory (host side) ----
+        # per-slot ring page table: logical page (pos // page_size)
+        # lives at ring index (pos // page_size) % pages_per_slot, so
+        # positions wrap through the table past max_ctx
+        p = program.pages_per_slot
+        self._pool = PagePool(program.n_pages)
+        self._trie: Optional[PrefixTrie] = (
+            PrefixTrie(program.page_size) if self.prefix_cache
+            else None)
+        self._table: List[List[Optional[int]]] = [[None] * p
+                                                  for _ in range(s)]
+        # -1 = not filling; else the next prompt position to chunk
+        self._fill_next = np.full(s, -1, np.int64)
+        # True while the slot's NEXT decode dispatch is the uniform
+        # first-token step: position len(prompt)-1, write suppressed
+        # (the prompt's cells are already paged in), emitting the
+        # first generated token — shared and unshared twins run the
+        # exact same step, which is what makes prefix sharing bitwise
+        self._first_step = np.zeros(s, bool)
+        # pages each slot registered into the trie (poison purge set)
+        self._trie_owned: List[List[int]] = [[] for _ in range(s)]
         # pending entries: (handle, replay_tokens or None)
         self._pending: deque = deque()
         # requests popped from pending but not yet resident (prefill
@@ -276,6 +562,11 @@ class DecodeEngine:
         self._tokens_emitted = 0
         self._steps = 0
         self._prefills = 0
+        self._prefill_chunks = 0
+        self._prefix_hits = 0          # joins that mapped >=1 page
+        self._prefix_page_hits = 0     # pages mapped from the trie
+        self._ctx_wraps = 0            # page recycles past the window
+        self._cow_copies = 0
         self._evictions = 0
         self._completed = 0
         self._quarantines = 0
@@ -420,6 +711,16 @@ class DecodeEngine:
             self._slot_req = [None] * self.max_slots
             self._slot_replay = [None] * self.max_slots
             self._placing = 0
+            # fresh pool => fresh virtual memory: page table, trie,
+            # refcounts, and page quarantine all restart from zero
+            p = self.program.pages_per_slot
+            self._pool = PagePool(self.program.n_pages)
+            self._trie = (PrefixTrie(self.program.page_size)
+                          if self.prefix_cache else None)
+            self._table = [[None] * p for _ in range(self.max_slots)]
+            self._fill_next[:] = -1
+            self._first_step[:] = False
+            self._trie_owned = [[] for _ in range(self.max_slots)]
         finally:
             if got:
                 self._step_lock.release()
@@ -470,11 +771,13 @@ class DecodeEngine:
         max_new_tokens = int(max_new_tokens)
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        if len(prompt) + max_new_tokens > self.program.model.max_ctx:
+        # the prompt must fit the attention window; the GENERATION may
+        # run past it — logical positions wrap through the page table
+        # (ring wrap), attending over the last `window` positions
+        if len(prompt) > self.program.window:
             raise ValueError(
-                f"prompt ({len(prompt)}) + max_new_tokens "
-                f"({max_new_tokens}) exceeds max_ctx "
-                f"{self.program.model.max_ctx}")
+                f"prompt ({len(prompt)}) exceeds the attention "
+                f"window {self.program.window}")
         resume = [int(t) for t in resume_tokens or []]
         if len(resume) > max_new_tokens:
             raise ValueError(
@@ -535,13 +838,14 @@ class DecodeEngine:
     # ------------------------------------------------------------- step
     def step_once(self) -> bool:
         """One engine iteration: deadline/cancel sweep, chaos check,
-        admit waiting requests to free healthy slots (bounded
-        prefills), one shared decode dispatch, per-slot finite-verdict
-        quarantine, harvest. Returns False when there was nothing to
-        do. Public so tests drive churn deterministically without the
-        loop thread. Telemetry (fault points aside, counters, gauges)
-        fires OUTSIDE the step lock — emission is never a blocking op
-        under a lock."""
+        admit/advance chunked prefills to free healthy slots (bounded
+        chunk dispatches), one shared decode dispatch over the
+        translated page table, per-slot finite-verdict quarantine,
+        harvest. Returns False when there was nothing to do. Public so
+        tests drive churn deterministically without the loop thread.
+        Telemetry (fault points aside, counters, gauges) fires OUTSIDE
+        the step lock — emission is never a blocking op under a
+        lock."""
         try:
             _fire("serving.slot_evict")
             evict = False
@@ -550,19 +854,30 @@ class DecodeEngine:
         prefill_s: List[float] = []
         quar_before = self._quarantines
         replays_before = self._replays
+        chunks_before = self._prefill_chunks
+        hits_before = self._prefix_page_hits
+        wraps_before = self._ctx_wraps
         with self._step_lock:
             n_deadline, n_cancel = self._sweep_deadlines()
             evicted = self._evict_lowest_active() if evict else 0
             admitted, emitted = self._admit_pending(prefill_s)
-            stepped = bool(self._active.any())
+            # slots still mid-prefill sit out the decode dispatch
+            # (their rows compute scratch-backed garbage the harvest
+            # ignores); everyone else needs a writable cell for the
+            # current position — alloc / ring wrap / copy-on-write
+            self._prepare_write_cells()
+            decoding = self._active & (self._fill_next < 0)
+            stepped = bool(decoding.any())
             if stepped:
+                cp, co, wp, wo = self._step_tables(decoding)
                 self.kv, nxt, ok = self.program.step(
-                    self.kv, self._tokens, self._positions)
+                    self.kv, self._tokens, self._positions, cp, co,
+                    wp, wo)
                 nxt_host = np.asarray(nxt)
                 ok_host = np.asarray(ok)
                 try:
                     # `decode.nonfinite` chaos site: force a poison
-                    # verdict on the lowest active slot — the NaN
+                    # verdict on the lowest decoding slot — the NaN
                     # drill without corrupting the shared weights. A
                     # hit must mean "this decode step" (the verdict it
                     # corrupts), so the fire cannot move outside the
@@ -570,13 +885,22 @@ class DecodeEngine:
                     # analyze: allow=thr-blocking-under-lock — chaos hit must align with the decode step it poisons
                     _fire("decode.nonfinite")
                 except FaultInjectedError:
-                    victims = np.flatnonzero(self._active)
+                    victims = np.flatnonzero(decoding)
                     if victims.size:
                         ok_host = ok_host.copy()
                         ok_host[victims[0]] = False
                 self._steps += 1
-                self._quarantine_poisoned(ok_host)
-                emitted += self._harvest(nxt_host)
+                self._quarantine_poisoned(ok_host, decoding)
+                emitted += self._harvest(nxt_host, decoding)
+        chunks = self._prefill_chunks - chunks_before
+        if chunks:
+            _obs.count("dl4j_decode_prefill_chunks_total", n=chunks)
+        hits = self._prefix_page_hits - hits_before
+        if hits:
+            _obs.count("dl4j_decode_prefix_hits_total", n=hits)
+        wraps = self._ctx_wraps - wraps_before
+        if wraps:
+            _obs.count("dl4j_decode_ctx_wraps_total", n=wraps)
         if evicted:
             _obs.count("dl4j_decode_slot_evictions_total", n=evicted)
         if n_deadline:
@@ -593,8 +917,8 @@ class DecodeEngine:
         if emitted:
             _obs.count("dl4j_decode_tokens_total", n=emitted)
         self._publish_gauges()
-        return bool(stepped or admitted or evicted or n_deadline
-                    or n_cancel)
+        return bool(stepped or admitted or chunks or evicted
+                    or n_deadline or n_cancel)
 
     def _sweep_deadlines(self) -> Tuple[int, int]:
         """Finish expired/cancelled streams with their PARTIAL tokens
@@ -637,9 +961,22 @@ class DecodeEngine:
         return n_deadline, n_cancel
 
     def _admit_pending(self, prefill_s: List[float]):
+        """Spend this step's chunk budget: advance in-flight chunked
+        prefills first (oldest slot first — a resident prompt finishes
+        before a new one starts competing), then place waiting
+        requests onto free healthy slots. A placement whose prompt is
+        FULLY covered by the prefix trie costs zero chunk dispatches —
+        the Kth same-prompt request skips prefill entirely (bounded
+        only by free slots)."""
         admitted = False
         emitted = 0
-        for _ in range(self.max_prefills_per_step):
+        budget = self.max_prefills_per_step
+        for s in range(self.max_slots):
+            if budget <= 0:
+                break
+            if self._active[s] and self._fill_next[s] >= 0:
+                budget -= self._advance_fill(s, prefill_s)
+        while budget > 0:
             free = [s for s in range(self.max_slots)
                     if not self._active[s] and not self._quarantined[s]]
             if not free:
@@ -650,8 +987,8 @@ class DecodeEngine:
                 handle, replay = self._pending.popleft()
                 self._placing += 1
             try:
-                emitted += self._place(handle, replay, free[0],
-                                       prefill_s)
+                budget -= self._place(handle, replay, free[0],
+                                      prefill_s)
             finally:
                 with self._cond:
                     self._placing -= 1
@@ -661,43 +998,192 @@ class DecodeEngine:
     def _place(self, handle: GenerationHandle,
                replay: Optional[List[int]], slot: int,
                prefill_s: List[float]) -> int:
-        """Prefill `handle`'s prompt into `slot` and make it resident.
-        `replay` (eviction/quarantine/migration recovery) carries the
-        already-emitted tokens: the re-prefill regenerates the first
-        one (same bucketed program, same prompt — bitwise the same
-        token) and the rest are force-fed through the decode loop
-        instead of re-emitted, so the stream's output is unaffected by
-        the recovery. Returns how many tokens were emitted (0 or 1)."""
-        t0 = time.perf_counter()
-        self.kv, first_dev = self.program.prefill(self.kv,
-                                                  handle.prompt, slot)
-        first = int(np.asarray(first_dev))
-        self._prefills += 1
-        prefill_s.append(time.perf_counter() - t0)
-        self._positions[slot] = len(handle.prompt)
+        """Make `handle` resident on `slot`: map its longest cached
+        prefix from the trie (refcounted read-only pages — the
+        shared-prefix capacity win), then start chunked prefill of
+        whatever the trie did not cover. `replay`
+        (eviction/quarantine/migration recovery) carries the
+        already-emitted tokens: the uniform first-token step
+        regenerates the first one (same programs, same cells —
+        bitwise the same token) and the recorded stream is force-fed
+        through the decode loop instead of re-emitted, so the output
+        is unaffected by the recovery. Returns the chunk dispatches
+        spent (0 on a full prefix hit)."""
         self._slot_req[slot] = handle
         self._active[slot] = True
+        self._slot_replay[slot] = deque(replay) if replay else None
         if replay:
             # forced replay: the recorded token stream IS the truth
             # (greedy decode would regenerate it; forcing makes the
             # recovery independent of it)
             self._replays += 1
-            self._tokens[slot] = replay[0]
-            self._slot_replay[slot] = deque(replay[1:]) or None
+        covered = 0
+        if self._trie is not None:
+            pages, covered = self._trie.match(handle.prompt)
+            for i, p in enumerate(pages):
+                self._pool.retain(p)
+                self._table[slot][i] = p
+            if pages:
+                self._prefix_hits += 1
+                self._prefix_page_hits += len(pages)
+        if covered >= len(handle.prompt):
+            self._fill_next[slot] = -1
+            self._fill_done(slot)
             return 0
-        self._slot_replay[slot] = None
-        self._tokens[slot] = first
-        handle._append(first)
-        self._tokens_emitted += 1
-        self._maybe_finish(slot, first)
+        self._fill_next[slot] = covered
+        return self._advance_fill(slot, prefill_s)
+
+    def _advance_fill(self, slot: int, prefill_s: List[float]) -> int:
+        """Dispatch ONE prompt chunk for a filling slot (page_size
+        tokens into one freshly allocated page). Returns the chunk
+        dispatches spent; 0 means the pool is exhausted beyond
+        recovery this step — the fill resumes next step."""
+        handle = self._slot_req[slot]
+        prompt = handle.prompt
+        ps = self.program.page_size
+        start = int(self._fill_next[slot])
+        page = self._alloc_page(slot)
+        if page is None:
+            return 0
+        t0 = time.perf_counter()
+        ring = (start // ps) % self.program.pages_per_slot
+        self._table[slot][ring] = page
+        cp, co = self.program.window_cells(self._table[slot],
+                                           start - 1)
+        self.kv = self.program.prefill_chunk(
+            self.kv, prompt[start:start + ps], start, cp, co, page)
+        self._prefill_chunks += 1
+        prefill_s.append(time.perf_counter() - t0)
+        nxt = start + ps
+        if nxt >= len(prompt):
+            self._fill_next[slot] = -1
+            self._fill_done(slot)
+        else:
+            self._fill_next[slot] = nxt
         return 1
 
-    def _harvest(self, nxt_host: np.ndarray) -> int:
+    def _fill_done(self, slot: int) -> None:
+        """The slot's prompt K/V is fully paged in (computed, shared,
+        or both): register its freshly computed pages into the trie
+        and arm the uniform first-token step — a decode dispatch at
+        position len(prompt)-1 with its WRITE SUPPRESSED (the cell
+        already holds the prefill's K/V), emitting the first generated
+        token. Shared and unshared twins run this exact step over
+        identical cell values, which is why prefix sharing is
+        bitwise-safe."""
+        handle = self._slot_req[slot]
+        if self._trie is not None:
+            self._trie_owned[slot] = self._trie.register(
+                handle.prompt, self._table[slot], self._pool)
+        self._prefills += 1
+        self._positions[slot] = len(handle.prompt) - 1
+        self._tokens[slot] = handle.prompt[-1]
+        self._first_step[slot] = True
+
+    # ------------------------------------------------ page allocation
+    def _alloc_page(self, for_slot: int) -> Optional[int]:
+        """Allocate one physical page for `for_slot`, reclaiming under
+        pressure: first LRU-evict trie-only cached pages, then evict
+        other resident requests (youngest slot first — they requeue
+        with replay, byte-identity preserved). Returns None only when
+        nothing more can be reclaimed this step."""
+        page = self._pool.alloc()
+        if page is not None:
+            return page
+        while self._trie is not None and self._trie.evict_lru(
+                self._pool):
+            page = self._pool.alloc()
+            if page is not None:
+                return page
+        victims = [s for s in range(self.max_slots)
+                   if self._active[s] and s != for_slot]
+        for v in reversed(victims):
+            self._evict_slot(v)
+            while (self._pool.free_count == 0
+                   and self._trie is not None
+                   and self._trie.evict_lru(self._pool)):
+                pass
+            page = self._pool.alloc()
+            if page is not None:
+                return page
+        return None
+
+    def _prepare_write_cells(self) -> None:
+        """Before the decode dispatch, every decoding slot (first-token
+        steps excepted — their write is suppressed) needs exclusive
+        ownership of the page holding its current position's cell:
+        alloc fresh territory, recycle its own ring entry past the
+        window (ctx wrap), or copy-on-write a page something else
+        still references (a trie registration or a prefix twin). A
+        slot the pool cannot serve even after reclaim is evicted —
+        it requeues with replay, losing nothing."""
+        ps = self.program.page_size
+        c = self.program.window
+        p = self.program.pages_per_slot
+        for s in range(self.max_slots):
+            if (not self._active[s] or self._fill_next[s] >= 0
+                    or self._first_step[s]):
+                continue
+            pos = int(self._positions[s])
+            ring = (pos // ps) % p
+            page = self._table[s][ring]
+            if pos >= c and pos % ps == 0:
+                # the ring entry comes back around: this slot starts
+                # recycling its own oldest page (sliding the window)
+                self._ctx_wraps += 1
+            if page is None:
+                page = self._alloc_page(s)
+                if page is None:
+                    self._evict_slot(s)
+                    continue
+                self._table[s][ring] = page
+            elif self._pool.ref[page] > 1:
+                # copy-on-write divergence: someone else (trie entry /
+                # prefix twin) still reads this page — fork it before
+                # the first private write lands
+                fresh = self._alloc_page(s)
+                if fresh is None:
+                    self._evict_slot(s)
+                    continue
+                self.kv = self.program.copy_page(self.kv, page, fresh)
+                self._pool.release(page)
+                self._table[s][ring] = fresh
+                self._cow_copies += 1
+
+    def _step_tables(self, decoding: np.ndarray):
+        """Translate the page table into the decode dispatch's cell
+        index arrays: [S, window] (page, offset) pairs in logical
+        token order per slot, plus each slot's write cell
+        (first-token steps and non-decoding rows write scratch)."""
+        from deeplearning4j_tpu.engine.decode_program import (
+            SCRATCH_PAGE,
+        )
+
+        s_n = self.max_slots
+        c = self.program.window
+        ps = self.program.page_size
+        p = self.program.pages_per_slot
+        cp = np.full((s_n, c), SCRATCH_PAGE, np.int32)
+        co = np.zeros((s_n, c), np.int32)
+        wp = np.full(s_n, SCRATCH_PAGE, np.int32)
+        wo = np.zeros(s_n, np.int32)
+        for s in np.flatnonzero(decoding):
+            pos = int(self._positions[s])
+            cp[s], co[s] = self.program.window_cells(self._table[s],
+                                                     pos)
+            if not self._first_step[s]:
+                wp[s] = self._table[s][(pos // ps) % p]
+                wo[s] = pos % ps
+        return cp, co, wp, wo
+
+    def _harvest(self, nxt_host: np.ndarray,
+                 decoding: np.ndarray) -> int:
         emitted = 0
         for s in range(self.max_slots):
-            if not self._active[s]:
+            if not decoding[s] or not self._active[s]:
                 continue
             self._positions[s] += 1
+            self._first_step[s] = False
             replay = self._slot_replay[s]
             if replay is not None:
                 forced = replay.popleft()
@@ -726,6 +1212,13 @@ class DecodeEngine:
         self._completed += 1
 
     def _free_slot(self, slot: int) -> None:
+        for ring, page in enumerate(self._table[slot]):
+            if page is not None:
+                self._pool.release(page)
+                self._table[slot][ring] = None
+        self._trie_owned[slot] = []
+        self._fill_next[slot] = -1
+        self._first_step[slot] = False
         self._active[slot] = False
         self._slot_req[slot] = None
         self._slot_replay[slot] = None
@@ -733,18 +1226,13 @@ class DecodeEngine:
         self._tokens[slot] = 0
 
     # --------------------------------------------------------- eviction
-    def _evict_lowest_active(self) -> int:
-        """Forced mid-generation eviction (the serving.slot_evict
-        drill): rip the lowest-indexed active request out of its slot
-        and queue it — FRONT of the line — for re-prefill + replay on
-        the next free slot. Replay-in-progress streams requeue with
-        their full recorded output; nothing is emitted twice. Returns
-        the eviction count (the caller emits the metric outside the
-        step lock)."""
-        victims = [s for s in range(self.max_slots) if self._active[s]]
-        if not victims:
-            return 0
-        s = victims[0]
+    def _evict_slot(self, s: int) -> None:
+        """Rip slot `s`'s request out mid-flight and queue it — FRONT
+        of the line — for re-prefill + replay on the next free slot.
+        Its mapped pages drop back to the pool (trie-cached copies of
+        a shared prefix survive, so the replay often costs nothing).
+        Replay-in-progress streams requeue with their full recorded
+        output; nothing is emitted twice."""
         handle = self._slot_req[s]
         recorded = handle.tokens_so_far()
         self._free_slot(s)
@@ -753,22 +1241,52 @@ class DecodeEngine:
         with self._cond:
             self._pending.appendleft((handle, recorded))
             self._cond.notify_all()
+
+    def _evict_lowest_active(self) -> int:
+        """Forced mid-generation eviction (the serving.slot_evict
+        drill): evict the lowest-indexed active request. Returns the
+        eviction count (the caller emits the metric outside the step
+        lock)."""
+        victims = [s for s in range(self.max_slots) if self._active[s]]
+        if not victims:
+            return 0
+        self._evict_slot(victims[0])
         return 1
 
     # ------------------------------------------------------- quarantine
-    def _quarantine_poisoned(self, ok_host: np.ndarray) -> None:
+    def _quarantine_poisoned(self, ok_host: np.ndarray,
+                             decoding: np.ndarray) -> None:
         """Apply the per-slot finite-logits verdict: a non-finite slot
-        is quarantined — never offered to `_admit_pending` again, its
-        KV pages written off — and its request replayed on a healthy
-        slot exactly like an eviction. A request that poisons
-        `poison_strike_limit`+1 slots carries the poison in its own
-        tokens: abort it with GenerationPoisonedError instead of
-        quarantining the whole batch one slot at a time."""
+        is quarantined — never offered to `_admit_pending` again — and
+        its request replayed on a healthy slot exactly like an
+        eviction. Quarantine is PAGE-granular against the pool: the
+        slot's privately-owned pages (nothing else references them)
+        are written off with it, but pages a trie entry or a prefix
+        twin still reads merely drop this slot's reference — the
+        poison wrote into the slot's private write cell, never into a
+        shared read-only page. The victim's own trie registrations ARE
+        suspect (it computed them) and are purged with quarantine
+        semantics. A request that poisons `poison_strike_limit`+1
+        slots carries the poison in its own tokens: abort it with
+        GenerationPoisonedError instead of quarantining the whole
+        batch one slot at a time."""
         for s in range(self.max_slots):
-            if not self._active[s] or bool(ok_host[s]):
+            if (not self._active[s] or not decoding[s]
+                    or bool(ok_host[s])):
                 continue
             handle = self._slot_req[s]
             recorded = handle.tokens_so_far()
+            if self._trie is not None and self._trie_owned[s]:
+                self._trie.purge(self._trie_owned[s], self._pool)
+                self._trie_owned[s] = []
+            for ring, page in enumerate(self._table[s]):
+                if page is None:
+                    continue
+                if int(self._pool.ref[page]) <= 1:
+                    self._pool.quarantine(page)
+                else:
+                    self._pool.release(page)
+                self._table[s][ring] = None
             self._free_slot(s)
             self._quarantined[s] = True
             self._quarantines += 1
@@ -792,6 +1310,9 @@ class DecodeEngine:
         elapsed = max(time.monotonic() - self._t0, 1e-9)
         _obs.set_gauge("dl4j_decode_tokens_per_s",
                        self._tokens_emitted / elapsed)
+        _obs.set_gauge("dl4j_decode_pages_free", self._pool.free_count)
+        _obs.set_gauge("dl4j_decode_prefix_pages_shared",
+                       self._pool.shared_count())
 
     def tokens_per_s(self) -> float:
         return self._tokens_emitted / max(time.monotonic() - self._t0,
@@ -807,7 +1328,20 @@ class DecodeEngine:
             "pending": pending,
             "queue_limit": self.queue_limit,
             "page_size": self.program.page_size,
-            "max_ctx": self.program.model.max_ctx,
+            "window": self.program.window,
+            "pages": {
+                "total": self.program.n_pages - 1,
+                "free": self._pool.free_count,
+                "shared": self._pool.shared_count(),
+                "quarantined": len(self._pool.quarantined),
+            },
+            "prefix_hits": self._prefix_page_hits,
+            "prefix_requests_hit": self._prefix_hits,
+            "prefill_chunks": self._prefill_chunks,
+            "ctx_wraps": self._ctx_wraps,
+            "cow_copies": self._cow_copies,
+            "trie_blocks": (len(self._trie)
+                            if self._trie is not None else 0),
             "steps": self._steps,
             "prefills": self._prefills,
             "tokens_total": self._tokens_emitted,
@@ -828,25 +1362,65 @@ def sequential_decode(program, prompt: Sequence[int],
                       max_new_tokens: int,
                       eos_id: Optional[int] = None, kv=None,
                       slot: int = 0):
-    """The per-request ORACLE: prefill + one-stream decode on the same
-    compiled programs the engine runs, one request at a time. Returns
-    (kv, tokens). Continuous-batched output must equal this bitwise
-    for every request regardless of slot churn — the correctness bar
-    that makes slot join/leave (and eviction/quarantine/migration
-    replay) trustworthy."""
+    """The per-request ORACLE: chunked prefill + one-stream decode on
+    the same compiled programs the engine runs, one request at a time,
+    through a trivially deterministic page allocator (pages handed out
+    in order, the ring reusing each slot page in place — no trie, no
+    sharing, no CoW). Returns (kv, tokens). Continuous-batched output
+    must equal this bitwise for every request regardless of slot
+    churn, prefix sharing, or context wrap — the correctness bar that
+    makes the paged virtual address space trustworthy."""
+    from deeplearning4j_tpu.engine.decode_program import SCRATCH_PAGE
+
     if kv is None:
         kv = program.init_kv()
-    tokens = np.zeros(program.max_slots, np.int32)
-    positions = np.zeros(program.max_slots, np.int32)
-    kv, first = program.prefill(kv, prompt, slot)
-    out = [int(np.asarray(first))]
-    tokens[slot] = out[0]
-    positions[slot] = len(list(prompt))
-    while len(out) < max_new_tokens and (eos_id is None
+    prompt = list(prompt)
+    ps = program.page_size
+    pps = program.pages_per_slot
+    table: List[Optional[int]] = [None] * pps
+    next_free = 1  # page 0 is scratch
+
+    def alloc() -> int:
+        nonlocal next_free
+        if next_free >= program.n_pages:
+            raise RuntimeError("oracle page pool exhausted")
+        next_free += 1
+        return next_free - 1
+
+    for start in program.chunk_starts(len(prompt)):
+        ring = (start // ps) % pps
+        if table[ring] is None:
+            table[ring] = alloc()
+        cp, co = program.window_cells(table, start - 1)
+        kv = program.prefill_chunk(kv, prompt[start:start + ps],
+                                   start, cp, co, table[ring])
+    out: List[int] = []
+    pos = len(prompt) - 1
+    tok = prompt[-1]
+    suppress = True  # first step: the prefill already wrote this cell
+    s_n = program.max_slots
+    c = program.window
+    tokens = np.zeros(s_n, np.int32)
+    positions = np.zeros(s_n, np.int32)
+    while len(out) < max_new_tokens and (eos_id is None or not out
                                          or out[-1] != eos_id):
-        kv, nxt, _ = program.step(kv, tokens, positions)
-        positions[slot] += 1
+        cp = np.full((s_n, c), SCRATCH_PAGE, np.int32)
+        co = np.zeros((s_n, c), np.int32)
+        wp = np.full(s_n, SCRATCH_PAGE, np.int32)
+        wo = np.zeros(s_n, np.int32)
+        ring = (pos // ps) % pps
+        if not suppress:
+            if table[ring] is None:
+                table[ring] = alloc()
+            wp[slot] = table[ring]
+            wo[slot] = pos % ps
+        tokens[slot] = tok
+        positions[slot] = pos
+        cp[slot], co[slot] = program.window_cells(table, pos)
+        kv, nxt, _ = program.step(kv, tokens, positions, cp, co,
+                                  wp, wo)
         tok = int(np.asarray(nxt)[slot])
         out.append(tok)
-        tokens[slot] = tok
+        pos += 1
+        suppress = False
     return kv, out

@@ -429,6 +429,16 @@ def telemetry_lines(snapshot) -> list:
         if "dl4j_decode_slot_evictions_total" in c:
             dec.append(f"{c['dl4j_decode_slot_evictions_total']} "
                        "evictions")
+        # paged KV virtual memory: prefix-hit rate (pages served from
+        # the trie vs pages computed by chunk prefill) + pool headroom
+        hits = c.get("dl4j_decode_prefix_hits_total", 0)
+        chunks = c.get("dl4j_decode_prefill_chunks_total", 0)
+        if hits + chunks:
+            rate = 100.0 * hits / (hits + chunks)
+            dec.append(f"prefix hit {rate:.0f}%")
+        pages_free = gauge("dl4j_decode_pages_free")
+        if pages_free is not None:
+            dec.append(f"{int(pages_free)} pages free")
         lines.append("decode — " + " · ".join(dec))
     # decode durability (quarantine / migration / watchdog restart /
     # deadline sweep) — shown once any of its counters has moved

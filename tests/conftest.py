@@ -57,6 +57,24 @@ def pytest_sessionfinish(session, exitstatus):
         pass
 
 
+# GC-during-tracing hardening. The full suite intermittently died with
+# "Fatal Python error: Segmentation fault ... Garbage-collecting" inside
+# pjit partial-eval, always in the thread-heavy training tests (prefetch
+# producers / inference batchers run JAX ops concurrently with
+# main-thread tracing): a cyclic-GC pass landing mid-trace races
+# jax's weakref-keyed caches. Freeze the post-import heap (the ~190
+# extension modules are permanent; scanning them every collection is
+# pure risk) and raise gen0's threshold so collections are rare enough
+# to stop landing inside trace/dispatch windows. Memory is bounded by
+# the per-test fixtures; RSS stays far under this box's budget.
+def pytest_sessionstart(session):
+    import gc
+
+    gc.collect()
+    gc.freeze()
+    gc.set_threshold(50_000, 50, 50)
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(12345)

@@ -101,26 +101,61 @@ def _drive_churn(program, reqs, stagger=2, eos=None, queue_limit=64,
 
 
 # ===================================================== program shapes
-def test_prefill_buckets_are_pow2_page_aligned(program):
-    assert program.bucket(1) == PAGE
-    assert program.bucket(PAGE) == PAGE
-    assert program.bucket(PAGE + 1) == 2 * PAGE
-    assert program.bucket(21) == next_pow2(21)
-    assert program.bucket(CTX) == CTX
+def test_chunk_schedule_is_page_aligned(program):
+    """Chunked prefill replaced pow2 prefill buckets: a prompt is a
+    page-aligned chunk dispatch per uncovered page, and the prefix
+    trie's coverage (always page-aligned or total) slots in as
+    `from_token`."""
+    assert program.chunk_starts(1) == [0]
+    assert program.chunk_starts(PAGE) == [0]
+    assert program.chunk_starts(PAGE + 1) == [0, PAGE]
+    assert program.chunk_starts(CTX) == list(range(0, CTX, PAGE))
+    assert program.chunk_starts(21, from_token=PAGE) == [PAGE, 2 * PAGE]
     for n in range(1, CTX + 1):
-        b = program.bucket(n)
-        assert b % PAGE == 0 and b & (b - 1) == 0 and n <= b <= CTX
+        starts = program.chunk_starts(n)
+        assert all(s % PAGE == 0 for s in starts)
+        assert starts[-1] < n <= starts[-1] + PAGE
     with pytest.raises(ValueError):
-        program.bucket(CTX + 1)
+        program.chunk_starts(CTX + 1)
     with pytest.raises(ValueError):
-        program.bucket(0)
+        program.chunk_starts(0)
 
 
-def test_kv_cache_is_head_major_per_slot(program):
+def test_kv_pool_is_page_and_head_major(program):
+    """The physical pool: [n_layers, 2, n_pages, n_heads, page_size,
+    head_dim] — page-major (one page id addresses every layer), head-
+    major within a page, head_dim innermost. Default n_pages matches
+    the PR 15 contiguous per-slot HBM budget + the scratch page."""
     m = program.model
-    assert program.kv_shape == (m.n_layers, 2, SLOTS, m.n_heads, CTX,
-                                m.head_dim)
+    assert program.pages_per_slot == CTX // PAGE
+    assert program.n_pages == SLOTS * program.pages_per_slot + 1
+    assert program.kv_shape == (m.n_layers, 2, program.n_pages,
+                                m.n_heads, PAGE, m.head_dim)
     assert program.init_kv().shape == program.kv_shape
+
+
+def test_window_cells_logical_order(program):
+    """Host-side virtual->physical translation: cell j is the j-th
+    oldest live position — the single reduction-order definition the
+    bitwise contract rests on — and dead cells park on scratch."""
+    from deeplearning4j_tpu.engine.decode_program import SCRATCH_PAGE
+
+    pps = program.pages_per_slot
+    table = [10 + r for r in range(pps)]
+    # mid-fill: positions 0..20 live
+    cp, co = program.window_cells(table, 20)
+    assert list(cp[:21]) == [10 + (q // PAGE) % pps for q in range(21)]
+    assert list(co[:21]) == [q % PAGE for q in range(21)]
+    assert set(cp[21:]) == {SCRATCH_PAGE} and set(co[21:]) == {0}
+    # wrapped: position CTX + 3 — the window slides, logical order
+    # starts at the oldest RETAINED position
+    cp, co = program.window_cells(table, CTX + 3)
+    qs = list(range(CTX + 4 - CTX, CTX + 4))
+    assert list(cp) == [10 + (q // PAGE) % pps for q in qs]
+    assert list(co) == [q % PAGE for q in qs]
+    # nothing live yet (the first chunk's prior context)
+    cp, co = program.window_cells(table, -1)
+    assert set(cp) == {SCRATCH_PAGE}
 
 
 def test_sequential_oracle_contract(program):
@@ -217,8 +252,11 @@ def test_streaming_accumulation_mid_generation(program):
     eng = DecodeEngine(program=program)
     h = eng.submit([1, 2, 3, 4], max_new_tokens=8)
     assert h.tokens_so_far() == []
-    # one engine iteration = admit (prefill emits the first token) +
-    # one decode dispatch (the second) — joins never wait a full pass
+    # one engine iteration = admit + chunk-prefill the short prompt +
+    # the uniform first-token decode dispatch — a join on a one-page
+    # prompt emits its first token the same step it is admitted
+    eng.step_once()
+    assert len(h.tokens_so_far()) == 1
     eng.step_once()
     assert len(h.tokens_so_far()) == 2
     eng.step_once()
@@ -239,7 +277,7 @@ def test_submit_validation_and_slot_exhaustion_429(program):
     with pytest.raises(ValueError):
         eng.submit([1], 0)
     with pytest.raises(ValueError):
-        eng.submit([1] * 10, CTX)     # prompt + max_new > max_ctx
+        eng.submit([1] * (CTX + 1), 4)   # prompt exceeds the window
     # capacity = max_slots resident + queue_limit waiting; the engine
     # is not stepping, so submissions pile up deterministically
     for _ in range(SLOTS + 1):
@@ -251,6 +289,11 @@ def test_submit_validation_and_slot_exhaustion_429(program):
     while eng._in_flight():
         eng.step_once()
     eng.submit([1, 2], 4)
+    # generation PAST the window is legal now — ring wrap recycles
+    # the slot's oldest pages (no prompt+max_new cap)
+    eng.submit([1] * 10, CTX)
+    while eng._in_flight():
+        eng.step_once()
 
 
 def test_admission_controller_fronts_the_engine(program):
@@ -322,6 +365,13 @@ def test_generate_over_http_npz_json_and_429(program):
         facts = client.status()
         assert facts["decode"]["decoder"]["completed"] >= 3
         assert facts["decode"]["decoder"]["max_slots"] == SLOTS
+        # page-table occupancy replaced the misleading per-slot
+        # max_ctx capacity: /status reports the real pool state
+        pages = facts["decode"]["decoder"]["pages"]
+        assert pages["total"] == SLOTS * (CTX // PAGE)
+        assert 0 <= pages["free"] <= pages["total"]
+        assert "max_ctx" not in facts["decode"]["decoder"]
+        assert facts["decode"]["decoder"]["window"] == CTX
         # slot exhaustion: stop the loop, queue a long generation per
         # slot (queue_limit=0 -> capacity == max_slots; a stopped
         # engine holds them pending deterministically), then one more
@@ -403,6 +453,17 @@ def test_dashboard_decode_line(program):
     decode = [l for l in lines if l.startswith("decode — ")]
     assert decode == [
         "decode — 3 slots · 123.4 tok/s · 420 tokens · 2 evictions"]
+    # paged-KV extension: prefix-hit rate (trie pages vs computed
+    # chunks) and pool headroom join the line when the metrics move
+    snapshot["counters"]["dl4j_decode_prefix_hits_total"] = {(): 30.0}
+    snapshot["counters"]["dl4j_decode_prefill_chunks_total"] = {
+        (): 10.0}
+    snapshot["gauges"]["dl4j_decode_pages_free"] = {(): 7.0}
+    decode = [l for l in telemetry_lines(snapshot)
+              if l.startswith("decode — ")]
+    assert decode == [
+        "decode — 3 slots · 123.4 tok/s · 420 tokens · 2 evictions"
+        " · prefix hit 75% · 7 pages free"]
     # absent domain -> no line
     assert not [l for l in telemetry_lines({"counters": {}})
                 if l.startswith("decode")]
@@ -443,7 +504,11 @@ def test_program_lint_decode_records_clean():
     records = _decode_records()
     names = {r.name for r in records}
     assert any(n.startswith("decode_step_s") for n in names)
-    assert any(n.startswith("decode_prefill_b") for n in names)
+    assert any(n.startswith("decode_prefill_c") for n in names)
+    assert "decode_page_copy" in names
+    # donation of the physical pool is DECLARED on every record, so
+    # prog-unhonored-donation checks the executable alias map
+    assert all(r.donate_argnums for r in records)
     findings = program_lint.run(records)
     assert findings == [], "; ".join(f.render() for f in findings)
 
