@@ -65,6 +65,21 @@ Modes:
       HBM MiB). Writes BENCH_decode_prefix_off.json /
       BENCH_decode_prefix.json on decode_prefix_tokens_per_sec, gated
       by `python tools/perf_gate.py --metric decode_prefix`.
+  python bench_serving.py decode_journal [n_requests]
+      write-ahead generation journal A/B (PR 18): the same mixed
+      request set through the SAME warmed DecodeProgram twice. OFF =
+      no journal. ON = every admit/progress/done lifecycle record
+      framed (length + sha256), appended to the per-engine WAL and
+      group-fsync'd on the default 50ms interval — the durable-serving
+      configuration every ModelServer(journal_dir=...) runs. Token
+      outputs asserted IDENTICAL between arms before any rate is
+      reported; the ON doc also carries the journal's record/fsync
+      counts and a group-commit sweep (fsync interval 0 / 10ms /
+      50ms — the durability-vs-throughput dial for PERF.md). Writes
+      BENCH_decode_journal_off.json / BENCH_decode_journal.json on
+      decode_journal_tokens_per_sec, gated by
+      `python tools/perf_gate.py --metric decode_journal` (<5%: the
+      journal must be invisible at decode speed).
   python bench_serving.py decode_chaos [n_requests]
       generation-durability chaos A/B (PR 16): the same mixed request
       set through a 3-replica decode fleet (ReplicaRouter +
@@ -1224,6 +1239,112 @@ def bench_decode(n_requests=64, max_slots=8, seed=0):
     return off_doc, on_doc
 
 
+# ---------------------------------------------- write-ahead journal
+def bench_decode_journal(n_requests=64, max_slots=8, seed=0,
+                         fsync_sweep=(0.0, 0.01, 0.05)):
+    """Write-ahead generation journal A/B (decode_journal mode —
+    story in the module docstring). OFF = no journal; ON = the WAL
+    armed at the default 50ms group-commit interval. Returns
+    (off_doc, on_doc) on decode_journal_tokens_per_sec; raises if the
+    two arms' token outputs are not identical. The ON doc carries the
+    fsync-interval sweep (durability dial) for PERF.md."""
+    import random
+    import shutil
+    import tempfile
+
+    from deeplearning4j_tpu.engine.decode_program import DecodeProgram
+    from deeplearning4j_tpu.serving.continuous import DecodeEngine
+    from deeplearning4j_tpu.serving.journal import GenerationJournal
+    from deeplearning4j_tpu.zoo.decoder import CausalTransformer
+
+    model = CausalTransformer(vocab_size=512, d_model=128, n_heads=8,
+                              n_layers=4, max_ctx=128, seed=7).init()
+    prog = DecodeProgram(model, max_slots=max_slots, page_size=16)
+    rng = random.Random(seed)
+    reqs = [([rng.randrange(model.vocab_size)
+              for _ in range(rng.randrange(4, 49))],
+             rng.randrange(8, 49)) for _ in range(n_requests)]
+    prog.warmup(prog.init_kv())
+
+    def run(fsync_interval_s=None):
+        """One timed continuous-batching pass; fsync_interval_s=None
+        means no journal at all (the OFF arm)."""
+        journal = tmp = None
+        if fsync_interval_s is not None:
+            tmp = tempfile.mkdtemp(prefix="dl4j-bench-journal-")
+            journal = GenerationJournal(
+                tmp, fsync_interval_s=fsync_interval_s)
+        eng = DecodeEngine(program=prog, queue_limit=n_requests,
+                           max_prefills_per_step=2, journal=journal)
+        try:
+            t0 = time.perf_counter()
+            handles = [eng.submit(p, mx) for p, mx in reqs]
+            while any(not h.done for h in handles):
+                eng.step_once()
+            dt = time.perf_counter() - t0
+            outs = [h.result(timeout_s=0) for h in handles]
+            jstats = journal.stats() if journal is not None else None
+        finally:
+            if journal is not None:
+                journal.close()
+            if tmp is not None:
+                shutil.rmtree(tmp, ignore_errors=True)
+        return outs, dt, jstats
+
+    # interleave 2 reps per arm; best rep is the headline (transients
+    # only ever slow a rep down — PERF.md hygiene)
+    off_outs, off_dt, _ = run(None)
+    on_outs, on_dt, jstats = run(0.05)
+    o2, odt2, _ = run(None)
+    j2, jdt2, _ = run(0.05)
+    if not (off_outs == on_outs == o2 == j2):
+        raise AssertionError(
+            "journaled tokens diverged from the journal-free arm — "
+            "byte-identity bar failed")
+    off_dt = min(off_dt, odt2)
+    on_dt = min(on_dt, jdt2)
+    tokens = sum(len(t) for t in off_outs)
+    # the durability dial: strict per-record fsync -> 10ms -> 50ms
+    # (best of 2 reps each, same hygiene as the headline arms)
+    sweep = {}
+    for interval in fsync_sweep:
+        _, dt_a, st_i = run(interval)
+        _, dt_b, _ = run(interval)
+        sweep[f"{int(round(interval * 1000))}ms"] = {
+            "tokens_per_sec": round(tokens / min(dt_a, dt_b), 1),
+            "fsyncs": st_i["fsyncs"],
+            "records": st_i["records"]}
+    config = (f"CausalTransformer v{model.vocab_size} d{model.d_model}"
+              f" h{model.n_heads} L{model.n_layers} ctx{model.max_ctx}"
+              f" f32; {n_requests} requests, prompts 4-48, outputs "
+              f"8-48, max_slots={max_slots} page=16; identical token "
+              "outputs asserted between arms; ON journals every "
+              "admit/progress/done record (sha256-framed WAL, 50ms "
+              "group fsync)")
+    base = {"metric": "decode_journal_tokens_per_sec", "unit": "tok/s",
+            "tokens": tokens, "requests": n_requests, "config": config}
+    off_doc = dict(base, value=round(tokens / off_dt, 1),
+                   wall_s=round(off_dt, 3), mode="journal_off")
+    on_doc = dict(base, value=round(tokens / on_dt, 1),
+                  wall_s=round(on_dt, 3), mode="journal_wal_50ms",
+                  vs_baseline=round(off_dt / on_dt, 3),
+                  journal_records=jstats["records"],
+                  journal_fsyncs=jstats["fsyncs"],
+                  journal_bytes=jstats["bytes"],
+                  fsync_sweep=sweep)
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        for doc in (off_doc, on_doc):
+            doc["device"] = str(dev.device_kind)
+            doc["platform"] = str(dev.platform)
+            doc["jax"] = jax.__version__
+    except Exception:   # noqa: BLE001 - device facts are best-effort
+        pass
+    return off_doc, on_doc
+
+
 # ------------------------------------------------ shared-prefix decode
 def bench_decode_prefix(n_requests=32, max_slots=8, seed=0,
                         page_size=16):
@@ -1659,6 +1780,17 @@ def main():
         with open("BENCH_decode_off.json", "w") as f:
             json.dump(off_doc, f, indent=2)
         with open("BENCH_decode_on.json", "w") as f:
+            json.dump(on_doc, f, indent=2)
+        print(json.dumps(on_doc))
+        return
+
+    if len(sys.argv) > 1 and sys.argv[1] in ("decode_journal",
+                                             "decode-journal"):
+        n = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+        off_doc, on_doc = bench_decode_journal(n_requests=n)
+        with open("BENCH_decode_journal_off.json", "w") as f:
+            json.dump(off_doc, f, indent=2)
+        with open("BENCH_decode_journal.json", "w") as f:
             json.dump(on_doc, f, indent=2)
         print(json.dumps(on_doc))
         return

@@ -51,7 +51,7 @@ METRIC_NAME = re.compile(r"\bdl4j_[a-z0-9_]+\b")
 # dl4j_ namespaces (w2v kernel labels etc.) are not metrics
 METRIC_DOMAINS = re.compile(
     r"dl4j_(train|serving|checkpoint|cluster|retry|breaker|jit|obs"
-    r"|perf|pipeline|mesh|fleet|rollout|decode)_")
+    r"|perf|pipeline|mesh|fleet|rollout|decode|journal)_")
 
 
 @dataclass

@@ -142,6 +142,14 @@ REGISTERED_METRICS = frozenset({
     "dl4j_cluster_spare_reschedules_total",
     "dl4j_cluster_shrinks_total",
     "dl4j_cluster_world_size",
+    # durable serving journal (serving/journal.py)
+    "dl4j_journal_records_total",
+    "dl4j_journal_fsyncs_total",
+    "dl4j_journal_torn_tails_total",
+    "dl4j_journal_recovered_requests_total",
+    "dl4j_journal_compactions_total",
+    "dl4j_journal_bytes",
+    "dl4j_journal_live",
     # derived by the registry itself (no count()/observe() call site)
     "dl4j_obs_dropped_emissions_total",
 })

@@ -71,8 +71,10 @@ class first before the bounded queue fills.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
+import uuid
 from typing import Optional
 
 import numpy as np
@@ -217,7 +219,7 @@ class ModelServer:
                  tracer=None, registry=None, admission=None,
                  tenants=None, model_name: str = "default",
                  queue_limit: int = 64, decode_engine=None,
-                 decode_engines=None):
+                 decode_engines=None, journal_dir: Optional[str] = None):
         from deeplearning4j_tpu.serving.admission import (
             AdmissionController,
             TenantConfig,
@@ -248,6 +250,15 @@ class ModelServer:
         self.decode_engines = dict(decode_engines or {})
         if decode_engine is not None:
             self.decode_engines.setdefault(model_name, decode_engine)
+        # durable serving: one write-ahead generation journal per
+        # model-version under `journal_dir` (serving/journal.py).
+        # Attaching RECOVERS — a server constructed on the journal dir
+        # a crashed process left behind re-admits every in-flight
+        # generation (resume_tokens replay) before it serves a request
+        self.journal_dir = journal_dir
+        self._journals = {}
+        for name, engine in self.decode_engines.items():
+            self._attach_journal(name, engine)
         self.tracer = tracer if tracer is not None \
             else getattr(self._default_pi(), "tracer", None)
         self.labels = labels
@@ -367,9 +378,30 @@ class ModelServer:
     # --------------------------------------------------------- generate
     def attach_decode_engine(self, name: str, engine) -> "ModelServer":
         """Attach a continuous-batching DecodeEngine to model `name`
-        (the /v1/models/<name>/generate route)."""
+        (the /v1/models/<name>/generate route). With `journal_dir`
+        set, the engine also gets its per-model-version write-ahead
+        journal (recovery included)."""
         self.decode_engines[name] = engine
+        self._attach_journal(name, engine)
         return self
+
+    def _attach_journal(self, name: str, engine) -> None:
+        """Open (or recover) model `name`'s journal and arm the
+        engine with it. Engines that already carry a journal keep it
+        (the caller-owned rule)."""
+        if self.journal_dir is None \
+                or getattr(engine, "_journal", None) is not None:
+            return
+        from deeplearning4j_tpu.serving.journal import GenerationJournal
+
+        try:
+            version = self.registry.entry(name).active or "v0"
+        except ModelNotFoundError:
+            version = "v0"
+        journal = GenerationJournal(
+            os.path.join(self.journal_dir, f"{name}@{version}"))
+        self._journals[name] = journal
+        engine.attach_journal(journal, recover=True)
 
     def _handle_generate(self, req: dict, model: Optional[str],
                          tenant: Optional[str] = None) -> dict:
@@ -397,6 +429,8 @@ class ModelServer:
             resume = req.get("resume_tokens")
             if resume is not None:
                 resume = [int(t) for t in np.asarray(resume).ravel()]
+            rid = req.get("request_id")
+            rid = None if rid is None else str(rid)
         except (TypeError, ValueError) as e:
             raise _ClientError(f"bad generate parameters: {e}") \
                 from None
@@ -415,7 +449,8 @@ class ModelServer:
         try:
             handle = engine.submit(prompt, max_new, eos_id=eos_id,
                                    tenant=tenant, deadline_s=deadline_s,
-                                   resume_tokens=resume)
+                                   resume_tokens=resume,
+                                   request_id=rid)
         except ValueError as e:
             raise _ClientError(str(e)) from None
         try:
@@ -447,6 +482,7 @@ class ModelServer:
             "finish_reason": handle.finish_reason,
             "evictions": handle.evictions,
             "replays": handle.replays,
+            "request_id": handle.request_id,
         }
 
     # ------------------------------------------------- lifecycle routes
@@ -521,6 +557,11 @@ class ModelServer:
             facts["decode"] = {name: engine.stats()
                                for name, engine
                                in self.decode_engines.items()}
+        # durable serving: per-model journal occupancy (live WAL
+        # entries, torn tails truncated, compactions, disk bytes)
+        if self._journals:
+            facts["journal"] = {name: j.stats()
+                                for name, j in self._journals.items()}
         # telemetry facts (observability/): uptime + the registry's
         # monotonic request/error counters (process-wide, survive
         # across this server's construction), plus span-buffer facts
@@ -838,6 +879,11 @@ class ModelServer:
             if engine is not None:
                 engine.stop()
         self._started_engines.clear()
+        # close journals AFTER the engines stop appending. Closing is
+        # not completion: requests the shutdown interrupted stay live
+        # on disk for the next process to recover
+        for journal in self._journals.values():
+            journal.close()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -1053,7 +1099,8 @@ class ModelClient:
                  timeout_s: Optional[float] = None,
                  deadline_s: Optional[float] = None,
                  resume_tokens=None,
-                 max_resumes: int = 3) -> dict:
+                 max_resumes: int = 3,
+                 request_id: Optional[str] = None) -> dict:
         """POST /v1/models/<model>/generate — continuous-batched
         autoregressive generation. Returns {"tokens": [int, ...],
         "finish_reason": "eos"|"length"|"deadline", ...}; the token
@@ -1071,9 +1118,16 @@ class ModelClient:
         an uninterrupted call — greedy decode replay, not re-sampling.
         `deadline_s` rides to the engine's deadline sweep; an expired
         deadline comes back as HTTP 504 whose partial stream is
-        returned here as a normal dict with finish_reason="deadline"."""
+        returned here as a normal dict with finish_reason="deadline".
+
+        `request_id` is the idempotency key (client-generated here
+        when not supplied): it is STABLE across every resume retry of
+        this logical call, so a retry after an ambiguous disconnect —
+        the response lost, the server's fate unknown — joins the
+        original journaled stream instead of double-executing."""
         resume = ([int(t) for t in np.asarray(resume_tokens).ravel()]
                   if resume_tokens is not None else [])
+        rid = str(request_id) if request_id else uuid.uuid4().hex
         last: Optional[Exception] = None
         for _ in range(max(0, int(max_resumes)) + 1):
             try:
@@ -1081,7 +1135,7 @@ class ModelClient:
                     prompt, max_new_tokens, eos_id=eos_id, model=model,
                     tenant=tenant, timeout_s=timeout_s,
                     deadline_s=deadline_s,
-                    resume_tokens=resume or None)
+                    resume_tokens=resume or None, request_id=rid)
             except (ServingError, RetriesExhaustedError) as e:
                 partial = self._resumable_partial(e)
                 if partial is None:
@@ -1114,10 +1168,13 @@ class ModelClient:
                        tenant: Optional[str],
                        timeout_s: Optional[float],
                        deadline_s: Optional[float],
-                       resume_tokens: Optional[list]) -> dict:
+                       resume_tokens: Optional[list],
+                       request_id: Optional[str] = None) -> dict:
         model = model or "default"
         route = f"/v1/models/{model}/generate"
         meta = {"max_new_tokens": int(max_new_tokens)}
+        if request_id is not None:
+            meta["request_id"] = str(request_id)
         if eos_id is not None:
             meta["eos_id"] = int(eos_id)
         if tenant is not None:

@@ -95,6 +95,19 @@ Fault points wired through the stack:
                      from its original prompt on the next healthy
                      replica, still losing nothing (greedy decode is
                      deterministic, so the output is unchanged)
+  journal.write_torn  GenerationJournal append, fired with the head
+                     segment path right after a record lands —
+                     `truncate` mauls the segment tail (the torn-write
+                     drill): recovery must truncate back to the last
+                     whole record and lose nothing before it
+  journal.fsync_fail  GenerationJournal group fsync, just before
+                     os.fsync — `raise` is consumed by keeping the
+                     unsynced bytes pending (the next flush retries):
+                     durability degrades, the data plane keeps serving
+  journal.recover_corrupt  GenerationJournal recovery scan, once per
+                     replayed record — `raise` declares THAT record
+                     corrupt: recovery treats it as a torn tail,
+                     truncating the segment to the records before it
 
 `REGISTERED_POINTS` is the canonical registry: every `fire(...)` site
 in the package must use a name listed there, and the test suite pins
@@ -139,6 +152,9 @@ REGISTERED_POINTS = frozenset({
     "dist.spare_exhausted",
     "inference.batch",
     "inference.complete",
+    "journal.fsync_fail",
+    "journal.recover_corrupt",
+    "journal.write_torn",
     "obs.emit",
     "rollout.canary_poison",
     "serve.request",

@@ -111,6 +111,7 @@ from __future__ import annotations
 
 import threading
 import time
+import uuid
 import weakref
 from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -155,10 +156,14 @@ class GenerationHandle:
 
     def __init__(self, prompt: List[int], max_new_tokens: int,
                  eos_id: Optional[int],
-                 deadline_s: Optional[float] = None):
+                 deadline_s: Optional[float] = None,
+                 request_id: Optional[str] = None,
+                 tenant: Optional[str] = None):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.eos_id = eos_id
+        self.request_id = request_id
+        self.tenant = tenant
         self.finish_reason: Optional[str] = None
         self.evictions = 0
         self.replays = 0
@@ -189,6 +194,16 @@ class GenerationHandle:
     def done(self) -> bool:
         with self._cond:
             return self._done
+
+    @property
+    def failed(self) -> bool:
+        """True once the stream finished WITH an error (engine
+        shutdown, poison exhaustion). A failed handle is a dead end:
+        a re-submit under the same request_id is a retry of work that
+        never completed, not a duplicate — the idempotency dedup must
+        not pin the caller to it."""
+        with self._cond:
+            return self._done and self._error is not None
 
     def cancel(self) -> None:
         """Request cancellation: the engine frees the slot at its next
@@ -487,7 +502,8 @@ class DecodeEngine:
                  max_engine_restarts: int = 3,
                  poison_strike_limit: int = 2,
                  n_pages: Optional[int] = None,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True,
+                 journal=None):
         from deeplearning4j_tpu.engine.decode_program import (
             DecodeProgram,
         )
@@ -574,7 +590,23 @@ class DecodeEngine:
         self._deadline_expired = 0
         self._cancelled = 0
         self._restarts = 0
+        # ---- durable serving (serving/journal.py) ----
+        # idempotency keys: live AND recently-done handles by request
+        # id (bounded retention), so a client retry after an ambiguous
+        # disconnect joins the original stream instead of
+        # double-executing; the journal (when attached) is the
+        # disk-backed leg of the same contract
+        self._journal = None
+        self._handles_by_id: Dict[str, GenerationHandle] = {}
+        self._done_ids: deque = deque()
+        self._done_retention = 1024
+        self._recovered = 0
+        # journal events collected under the step lock, written after
+        # it (file I/O is never a step-lock holder)
+        self._jevents: List[tuple] = []
         _LIVE_ENGINES.add(self)
+        if journal is not None:
+            self.attach_journal(journal)
 
     # -------------------------------------------------------- lifecycle
     def start(self) -> "DecodeEngine":
@@ -748,7 +780,8 @@ class DecodeEngine:
                eos_id: Optional[int] = None,
                tenant: Optional[str] = None,
                deadline_s: Optional[float] = None,
-               resume_tokens: Optional[Sequence[int]] = None
+               resume_tokens: Optional[Sequence[int]] = None,
+               request_id: Optional[str] = None
                ) -> GenerationHandle:
         """Admit one generation request (non-blocking). Raises
         QuotaExceededError (HTTP 429 + Retry-After) on tenant quota /
@@ -764,7 +797,14 @@ class DecodeEngine:
 
         `deadline_s` bounds the request's wall-clock life from this
         submit: past it, the slot is freed and the handle finishes
-        with its partial tokens and finish_reason "deadline"."""
+        with its partial tokens and finish_reason "deadline".
+
+        `request_id` is the idempotency key: re-submitting an id the
+        engine already knows (live, recently done, or recovered from
+        the journal) returns the ORIGINAL handle — nothing is
+        double-journaled or double-executed. With a journal attached,
+        the admitted record is written BEFORE the request becomes
+        visible to the step loop (write-ahead)."""
         prompt = [int(t) for t in np.asarray(prompt, np.int64).ravel()]
         if not prompt:
             raise ValueError("prompt must carry at least one token")
@@ -783,32 +823,72 @@ class DecodeEngine:
             raise ValueError(
                 f"resume_tokens ({len(resume)}) exceeds "
                 f"max_new_tokens ({max_new_tokens})")
+        rid = str(request_id) if request_id else uuid.uuid4().hex
+        # idempotency: join the id's existing stream — live, finished,
+        # or recovered — EXCEPT one that failed (engine shutdown): the
+        # retry after such a failure (the resume-on-disconnect leg)
+        # must get a fresh life, not the dead handle back
+        with self._cond:
+            existing = self._handles_by_id.get(rid)
+        if existing is not None and not existing.failed:
+            return existing
         handle = GenerationHandle(prompt, max_new_tokens, eos_id,
-                                  deadline_s=deadline_s)
+                                  deadline_s=deadline_s,
+                                  request_id=rid, tenant=tenant)
         if resume:
             handle._preload(resume)
             handle.replays += 1
             # the earlier life may already have finished the stream
+            finished = None
             if eos_id is not None and resume[-1] == eos_id:
-                handle._finish("eos")
-                return handle
-            if len(resume) >= max_new_tokens:
-                handle._finish("length")
+                finished = "eos"
+            elif len(resume) >= max_new_tokens:
+                finished = "length"
+            if finished is not None:
+                handle._finish(finished)
+                with self._cond:
+                    cur = self._handles_by_id.get(rid)
+                    if cur is None or cur.failed:
+                        self._handles_by_id[rid] = handle
+                self._journal_safe(
+                    lambda: self._journal.append_admitted(
+                        rid, prompt, max_new_tokens, eos_id=eos_id,
+                        tenant=tenant, deadline_s=deadline_s))
+                self._journal_safe(
+                    lambda: self._journal.record_progress(rid, resume))
+                self._journal_safe(
+                    lambda: self._journal.append_done(rid, finished))
+                self._note_done_id(rid)
                 return handle
         capacity = self.max_slots + self.queue_limit
         depth = self._in_flight()
         if self.admission is not None:
             self.admission.admit(tenant, self.model_name, depth,
                                  capacity)
+        # WRITE-AHEAD: the admitted record (and any resume progress)
+        # lands on disk before the step loop can see the request; a
+        # shed below appends done("shed") so the journal stays clean
+        self._journal_safe(lambda: self._journal.append_admitted(
+            rid, prompt, max_new_tokens, eos_id=eos_id, tenant=tenant,
+            deadline_s=deadline_s))
+        if resume:
+            self._journal_safe(
+                lambda: self._journal.record_progress(rid, resume))
         with self._cond:
+            racer = self._handles_by_id.get(rid)
+            if racer is not None and not racer.failed:
+                return racer
             if (int(self._active.sum()) + len(self._pending)
                     + self._placing) >= capacity:
                 shed = True
             else:
                 shed = False
+                self._handles_by_id[rid] = handle
                 self._pending.append((handle, resume or None))
                 self._cond.notify_all()
         if shed:
+            self._journal_safe(
+                lambda: self._journal.append_done(rid, "shed"))
             raise QuotaExceededError(
                 f"decode slots exhausted ({self.max_slots} resident, "
                 f"{self.queue_limit} waiting)", tenant=tenant or "",
@@ -834,6 +914,95 @@ class DecodeEngine:
         with self._cond:
             return (int(self._active.sum()) + len(self._pending)
                     + self._placing)
+
+    # ------------------------------------------ durability (journal)
+    def attach_journal(self, journal,
+                       recover: bool = True) -> "DecodeEngine":
+        """Arm the write-ahead journal. With `recover=True` (the
+        default), every request the journal holds LIVE — a previous
+        process's crash — is re-submitted as a resume_tokens
+        continuation through the bitwise replay path, under its
+        original request id (so a client's idempotent re-submit joins
+        the recovered stream). A live request a FRESH engine cannot
+        carry (stale journal: prompt past this engine's window, or
+        recovery overflowing capacity) is marked done("unrecoverable")
+        instead of wedging recovery forever."""
+        self._journal = journal
+        if not recover:
+            return self
+        recovered = 0
+        live = journal.live()
+        for rid in sorted(live):
+            req = live[rid]
+            try:
+                self.submit(req["prompt"], req["max_new_tokens"],
+                            eos_id=req.get("eos_id"),
+                            tenant=req.get("tenant"),
+                            deadline_s=req.get("deadline_s"),
+                            resume_tokens=req.get("tokens") or None,
+                            request_id=rid)
+                recovered += 1
+            except (ValueError, QuotaExceededError):
+                journal.append_done(rid, "unrecoverable")
+        self._recovered += recovered
+        if recovered:
+            _obs.count("dl4j_journal_recovered_requests_total",
+                       n=recovered)
+        return self
+
+    def _journal_safe(self, fn) -> None:
+        """Run one journal operation, swallowing its failure: a sick
+        journal degrades durability, it never takes the data plane
+        down (the same guarded-telemetry discipline as _obs)."""
+        if self._journal is None:
+            return
+        try:
+            fn()
+        except Exception:  # noqa — durability degrades, serving continues; journal failures must not poison the data plane
+            pass
+
+    def _note_done_id(self, rid: Optional[str]) -> None:
+        """Bounded retention for finished idempotency keys: keep the
+        last `_done_retention` done handles findable (a retry joins
+        them) without growing the map forever."""
+        if not rid:
+            return
+        with self._cond:
+            self._done_ids.append(rid)
+            while len(self._done_ids) > self._done_retention:
+                self._handles_by_id.pop(self._done_ids.popleft(), None)
+
+    def _write_journal(self, events: List[tuple]) -> None:
+        """Drain one step's journal events OUTSIDE the step lock:
+        progress deltas first (the journal computes the delta from the
+        handle's full token list — absolute positions keep replays
+        idempotent), then terminal records, then a group-commit
+        checkpoint under the journal's fsync policy. Crash-shaped
+        finishes (engine stop, restart exhaustion, evictions) are
+        never in `events` — those streams must stay live on disk."""
+        j = self._journal
+        if j is None or not events:
+            return
+        progressed = set()
+        for ev in events:
+            kind, handle = ev[0], ev[1]
+            rid = handle.request_id
+            if rid is None:
+                continue
+            if kind == "progress":
+                if rid in progressed:
+                    continue
+                progressed.add(rid)
+                self._journal_safe(lambda h=handle: j.record_progress(
+                    h.request_id, h.tokens_so_far()))
+            else:
+                # the final tokens land before the done marker
+                self._journal_safe(lambda h=handle: j.record_progress(
+                    h.request_id, h.tokens_so_far()))
+                self._journal_safe(lambda h=handle, r=ev[2]:
+                                   j.append_done(h.request_id, r))
+                self._note_done_id(rid)
+        self._journal_safe(lambda: j.flush(force=False))
 
     # ------------------------------------------------------------- step
     def step_once(self) -> bool:
@@ -892,6 +1061,7 @@ class DecodeEngine:
                 self._steps += 1
                 self._quarantine_poisoned(ok_host, decoding)
                 emitted += self._harvest(nxt_host, decoding)
+            jevents, self._jevents = self._jevents, []
         chunks = self._prefill_chunks - chunks_before
         if chunks:
             _obs.count("dl4j_decode_prefill_chunks_total", n=chunks)
@@ -917,6 +1087,7 @@ class DecodeEngine:
         if emitted:
             _obs.count("dl4j_decode_tokens_total", n=emitted)
         self._publish_gauges()
+        self._write_journal(jevents)
         return bool(stepped or admitted or chunks or evicted
                     or n_deadline or n_cancel)
 
@@ -943,6 +1114,7 @@ class DecodeEngine:
                         kept.append((handle, replay))
                         continue
                     handle._finish(reason)
+                    self._jevents.append(("done", handle, reason))
                     n_deadline += reason == "deadline"
                     n_cancel += reason == "cancelled"
                 self._pending = kept
@@ -952,7 +1124,9 @@ class DecodeEngine:
             reason = _verdict(self._slot_req[s])
             if reason is None:
                 continue
-            self._slot_req[s]._finish(reason)
+            handle = self._slot_req[s]
+            handle._finish(reason)
+            self._jevents.append(("done", handle, reason))
             self._free_slot(s)
             n_deadline += reason == "deadline"
             n_cancel += reason == "cancelled"
@@ -1195,6 +1369,7 @@ class DecodeEngine:
             self._tokens[s] = tok
             handle = self._slot_req[s]
             handle._append(tok)
+            self._jevents.append(("progress", handle))
             emitted += 1
             self._tokens_emitted += 1
             self._maybe_finish(s, tok)
@@ -1203,11 +1378,13 @@ class DecodeEngine:
     def _maybe_finish(self, slot: int, tok: int) -> None:
         handle = self._slot_req[slot]
         if handle.eos_id is not None and tok == handle.eos_id:
-            handle._finish("eos")
+            reason = "eos"
         elif len(handle.tokens_so_far()) >= handle.max_new_tokens:
-            handle._finish("length")
+            reason = "length"
         else:
             return
+        handle._finish(reason)
+        self._jevents.append(("done", handle, reason))
         self._free_slot(slot)
         self._completed += 1
 
@@ -1298,6 +1475,7 @@ class DecodeEngine:
                     f"aborting instead of replaying further",
                     model=self.model_name,
                     strikes=handle.poison_strikes))
+                self._jevents.append(("done", handle, "poisoned"))
                 continue
             with self._cond:
                 self._pending.appendleft((handle, recorded or None))
@@ -1355,6 +1533,9 @@ class DecodeEngine:
             "engine_restarts": self._restarts,
             "tokens_per_s": round(self.tokens_per_s(), 3),
             "trace_counts": self.program.trace_stats()["trace_counts"],
+            "journal": (dict(self._journal.stats(),
+                             recovered=self._recovered)
+                        if self._journal is not None else None),
         }
 
 

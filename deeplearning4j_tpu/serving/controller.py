@@ -57,6 +57,7 @@ controller can conjure; the drills account for this.
 from __future__ import annotations
 
 import logging
+import os
 import re
 import threading
 import time
@@ -373,6 +374,7 @@ class FleetController:
                  scale_down_rps_per_replica: float = 1.0,
                  drain_timeout_s: float = 10.0,
                  holddown_s: float = 300.0,
+                 state_dir: Optional[str] = None,
                  clock: Callable[[], float] = time.monotonic,
                  sleep: Callable[[float], None] = time.sleep):
         self.replicas = list(replicas)
@@ -405,6 +407,16 @@ class FleetController:
         self._last_fleet_sample: Optional[dict] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # durable control plane: hold-down ledger + autoscaler target
+        # persisted with the journal's record framing (`state_dir`), so
+        # a restarted controller refuses to re-canary a held build
+        self._state_path: Optional[str] = None
+        self._restored_target: Optional[int] = None
+        if state_dir is not None:
+            os.makedirs(state_dir, exist_ok=True)
+            self._state_path = os.path.join(state_dir,
+                                            "controller.state")
+            self._restore_state()
         self._emit_pool_gauge()
         self._set_state("idle")
 
@@ -453,11 +465,73 @@ class FleetController:
             entry["reason"] = reason
         _obs.count("dl4j_rollout_holddowns_total",
                    labels={"model": model})
+        self._persist_state()
 
     def clear_holddown(self, model: str, version: str) -> None:
         """Operator override: release a held-down version."""
         with self._lock:
             self._holddown.pop((model, version), None)
+        self._persist_state()
+
+    # -------------------------------------------------- state durability
+    def _persist_state(self) -> None:
+        """Publish the hold-down ledger + autoscaler target to the
+        state file — the journal's record framing through the atomic
+        writer, so a kill mid-write leaves the previous state intact.
+        Monotonic deadlines convert to wall clock for the trip through
+        disk (a restart gets a fresh monotonic epoch). Runs OUTSIDE
+        the membership lock; file I/O never holds it."""
+        if self._state_path is None:
+            return
+        from deeplearning4j_tpu.serving.journal import write_records
+
+        now_m, now_w = self._clock(), time.time()
+        with self._lock:
+            records = [{"kind": "holddown", "model": m, "version": v,
+                        "failures": e["failures"],
+                        "until_wall": now_w + (e["until"] - now_m),
+                        "reason": e["reason"]}
+                       for (m, v), e in self._holddown.items()]
+            records.append({"kind": "autoscaler",
+                            "target": len(self.replicas),
+                            "scale_events": dict(self._scale_events)})
+        try:
+            write_records(self._state_path, records)
+        except OSError:
+            logger.warning("controller state persist to %s failed",
+                           self._state_path, exc_info=True)
+
+    def _restore_state(self) -> None:
+        """Load whatever a previous controller persisted: expired
+        hold-downs are dropped, live ones re-enter the ledger with
+        their remaining wall-clock time; the autoscaler target is
+        surfaced in stats() for the operator (membership itself is
+        re-discovered from the router/factory, not conjured)."""
+        if self._state_path is None \
+                or not os.path.exists(self._state_path):
+            return
+        from deeplearning4j_tpu.serving.journal import read_records
+
+        now_m, now_w = self._clock(), time.time()
+        records, _, _ = read_records(self._state_path)
+        for rec in records:
+            if rec.get("kind") == "holddown":
+                remaining = float(rec.get("until_wall", 0.0)) - now_w
+                if remaining <= 0:
+                    continue
+                key = (str(rec.get("model")), str(rec.get("version")))
+                with self._lock:
+                    self._holddown[key] = {
+                        "failures": int(rec.get("failures", 1)),
+                        "until": now_m + remaining,
+                        "reason": str(rec.get("reason",
+                                              "restored from disk")),
+                    }
+            elif rec.get("kind") == "autoscaler":
+                target = rec.get("target")
+                self._restored_target = (int(target)
+                                         if target is not None
+                                         else None)
 
     # ---------------------------------------------------------- rollout
     def rollout(self, model: str, version: str,
@@ -789,6 +863,7 @@ class FleetController:
             self._scale_events["up"] += 1
         _obs.count("dl4j_fleet_scale_events_total",
                    labels={"direction": "up"})
+        self._persist_state()
 
     def _scale_down(self, now: float) -> None:
         with self._lock:
@@ -818,6 +893,7 @@ class FleetController:
         _obs.count("dl4j_fleet_scale_events_total",
                    labels={"direction": "down"})
         self._emit_pool_gauge()
+        self._persist_state()
 
     # ------------------------------------------------------------ facts
     def stats(self) -> dict:
@@ -841,5 +917,7 @@ class FleetController:
                                     else None),
                     "min": self.min_replicas,
                     "max": self.max_replicas,
+                    "restored_target": self._restored_target,
                 },
+                "state_path": self._state_path,
             }

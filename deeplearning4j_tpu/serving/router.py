@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import threading
 import time
+import uuid
 from typing import Callable, List, Optional
 
 from deeplearning4j_tpu.observability import metrics as _obs
@@ -247,7 +248,8 @@ class ReplicaRouter:
                  tenant: Optional[str] = None,
                  timeout_s: Optional[float] = None,
                  deadline_s: Optional[float] = None,
-                 resume_tokens: Optional[list] = None) -> dict:
+                 resume_tokens: Optional[list] = None,
+                 request_id: Optional[str] = None) -> dict:
         """One logical generation over the fleet, with cross-replica
         MIGRATION: when the serving replica dies or retires
         mid-generation, its resumable 503 body (tokens decoded so far)
@@ -262,12 +264,19 @@ class ReplicaRouter:
         restarts from the prompt — still losing nothing.
 
         The response dict gains `migrations`: how many times this
-        request's partial stream moved between replicas."""
+        request's partial stream moved between replicas.
+
+        `request_id` (client-generated here when not supplied) is ONE
+        idempotency key for the whole logical request: every failover
+        attempt carries it, so a replica that already journaled the
+        stream — including one recovered from its journal after a
+        fleet-wide outage — joins it instead of double-executing."""
         tried: set = set()
         causes: list = []
         last: Optional[Exception] = None
         resume = ([int(t) for t in resume_tokens]
                   if resume_tokens else [])
+        rid = str(request_id) if request_id else uuid.uuid4().hex
         migrations = 0
         while True:
             r = self._pick(tried)
@@ -295,7 +304,8 @@ class ReplicaRouter:
                     prompt, max_new_tokens, eos_id=eos_id, model=model,
                     tenant=tenant, timeout_s=timeout_s,
                     deadline_s=deadline_s,
-                    resume_tokens=continuation or None, max_resumes=0)
+                    resume_tokens=continuation or None, max_resumes=0,
+                    request_id=rid)
             except _FAILOVER as exc:
                 removed = not self._is_member(r)
                 self._release(r, failed=not removed)

@@ -455,6 +455,19 @@ def telemetry_lines(snapshot) -> list:
             "engine restarts · "
             f"{c.get('dl4j_decode_deadline_expired_total', 0)} "
             "deadline expiries")
+    # durable serving journal (serving/journal.py): live WAL occupancy,
+    # cold-restart recoveries, torn tails truncated
+    journal_live = gauge("dl4j_journal_live")
+    if journal_live is not None or any(k in c for k in (
+            "dl4j_journal_records_total",
+            "dl4j_journal_recovered_requests_total",
+            "dl4j_journal_torn_tails_total")):
+        lines.append(
+            "journal — "
+            f"{int(journal_live or 0)} live · "
+            f"{c.get('dl4j_journal_recovered_requests_total', 0)} "
+            "recovered · "
+            f"{c.get('dl4j_journal_torn_tails_total', 0)} torn tails")
     # performance introspection (observability/perf.py): cost-model
     # MFU gauge, top phases by attributed share, recompile count
     perf = []

@@ -143,6 +143,21 @@ def _reap_decode_engines():
 
 
 @pytest.fixture(autouse=True)
+def _reap_journals():
+    """Chaos isolation for DURABLE STATE: a failing/interrupted journal
+    drill must not leak an open write-ahead segment handle or an
+    ephemeral journal temp dir into later tests — close every journal
+    the module still tracks and remove the scratch dirs it minted.
+    Lazy: touches nothing unless the module was actually imported."""
+    import sys as _sys
+
+    yield
+    mod = _sys.modules.get("deeplearning4j_tpu.serving.journal")
+    if mod is not None:
+        mod.reap_stray_journals()
+
+
+@pytest.fixture(autouse=True)
 def _clear_faults():
     """Chaos isolation: no armed fault may leak into the next test."""
     from deeplearning4j_tpu.resilience.faults import injector
