@@ -118,6 +118,11 @@ class DecodeProgram:
 
         self.precision_policy = policy_name(
             getattr(model, "compute_dtype", None))
+        # host-side dispatch tally per program kind — trace-counter
+        # siblings that count EXECUTIONS rather than retraces, so the
+        # engine's stats (and the tracing story) can report how many
+        # device dispatches a generation actually cost
+        self._dispatches = {"step": 0, "chunk": 0, "copy": 0}
 
     # ---------------------------------------------------------- layout
     @property
@@ -347,6 +352,7 @@ class DecodeProgram:
         import jax.numpy as jnp
 
         fn = self._decode_program()
+        self._dispatches["step"] += 1
         return fn(self.model.params, kv,
                   jnp.asarray(tokens, jnp.int32),
                   jnp.asarray(positions, jnp.int32),
@@ -368,6 +374,7 @@ class DecodeProgram:
         padded = np.zeros(self.page_size, np.int32)
         padded[:len(chunk)] = chunk
         fn = self._chunk_program()
+        self._dispatches["chunk"] += 1
         return fn(self.model.params, kv, jnp.asarray(padded),
                   jnp.int32(start),
                   jnp.asarray(cell_page, jnp.int32),
@@ -380,6 +387,7 @@ class DecodeProgram:
         import jax.numpy as jnp
 
         fn = self._copy_program()
+        self._dispatches["copy"] += 1
         return fn(kv, jnp.int32(src), jnp.int32(dst))
 
     def warmup(self, kv, buckets: Sequence[int] = ()):
@@ -409,7 +417,8 @@ class DecodeProgram:
         return {"trace_counts": cache.trace_counts(),
                 "total_traces": cache.total_traces(),
                 "compiles_total": cache.compiles_total(),
-                "compile_events": cache.compile_events()}
+                "compile_events": cache.compile_events(),
+                "dispatches": dict(self._dispatches)}
 
     # ------------------------------------------------------------ lint
     def lint_records(self, buckets: Sequence[int] = ()) -> List:

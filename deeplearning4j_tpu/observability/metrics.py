@@ -117,6 +117,12 @@ REGISTERED_METRICS = frozenset({
     "dl4j_decode_replays_total",
     "dl4j_decode_deadline_expired_total",
     "dl4j_decode_engine_restarts_total",
+    # per-request latency attribution (TTFT / inter-token / queue wait,
+    # labeled by tenant class) + the crash flight recorder
+    "dl4j_decode_ttft_seconds",
+    "dl4j_decode_itl_seconds",
+    "dl4j_decode_queue_wait_seconds",
+    "dl4j_decode_flight_dumps_total",
     "dl4j_jit_traces_total",
     "dl4j_jit_compiles_total",
     # performance introspection (observability/perf.py)

@@ -30,6 +30,16 @@ feeding `export_chrome_trace()` for ad-hoc snapshots between flushes.
 
 Tracing is opt-in per component (`tracer=None` default everywhere):
 the hot paths pay nothing unless a tracer is attached.
+
+Cross-process requests: a generation that migrates between replicas
+(or is recovered from the journal after a cold restart) leaves one
+trace LEG per process, each tagged with the same `trace` arg (a
+`new_trace_id()` riding the wire meta next to `request_id`).
+`merge_chrome_traces()` folds the per-process exports into ONE
+Perfetto document — distinct pids per leg, clocks aligned via each
+doc's `unix_time_origin_s`, and an "s"/"f" flow arrow binding each
+trace's consecutive legs so the hop renders as an arrow, not two
+unrelated timelines.
 """
 
 from __future__ import annotations
@@ -39,9 +49,17 @@ import json
 import os
 import threading
 import time
+import uuid
 from collections import deque
 from contextlib import contextmanager
 from typing import Dict, List, Optional
+
+
+def new_trace_id() -> str:
+    """Fresh 16-hex trace id (traceparent-style, wire-safe). Minted by
+    whichever hop sees the request first (router, server, or engine)
+    and then propagated verbatim alongside `request_id`."""
+    return uuid.uuid4().hex[:16]
 
 
 class Span:
@@ -351,3 +369,86 @@ class Tracer:
             with open(path, "w") as f:
                 json.dump(doc, f)
         return doc
+
+
+# ------------------------------------------------- cross-process merge
+def _load_trace_doc(doc_or_path):
+    if isinstance(doc_or_path, str):
+        with open(doc_or_path) as f:
+            return json.load(f)
+    return doc_or_path
+
+
+def merge_chrome_traces(docs, path: Optional[str] = None,
+                        labels: Optional[List[str]] = None) -> dict:
+    """Merge per-process `export_chrome_trace()` docs into ONE
+    Perfetto-loadable document (the snapshot-aggregation pattern,
+    applied to traces).
+
+    Each input doc becomes a distinct pid (its process_name from
+    `labels`, else "proc<i>"), timestamps are rebased onto a shared
+    origin using each doc's `otherData.unix_time_origin_s` wall clock,
+    and per-doc flow ids are remapped so they cannot collide. Then, for
+    every trace id seen (the `trace` span arg), the legs — one group of
+    spans per input doc — are ordered by start time and consecutive
+    legs are bound with an "s"/"f" flow pair named "trace-leg": the
+    migration (or journal-recovery) hop renders as an arrow from the
+    end of the last span of one replica's leg to the first span of the
+    next replica's leg. Accepts doc dicts or file paths."""
+    loaded = [_load_trace_doc(d) for d in docs]
+    origins = [float((d.get("otherData") or {})
+                     .get("unix_time_origin_s", 0.0)) for d in loaded]
+    base = min(origins) if origins else 0.0
+    events: List[dict] = []
+    # per-trace-id legs: {trace_id: {doc_idx: [(ts, end_ts, ev), ...]}}
+    legs: Dict[str, Dict[int, List[tuple]]] = {}
+    for i, (doc, origin) in enumerate(zip(loaded, origins)):
+        pid = i + 1
+        shift_us = (origin - base) * 1e6
+        name = (labels[i] if labels and i < len(labels)
+                else f"proc{i}")
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "tid": 0, "args": {"name": name}})
+        events.append({"ph": "M", "name": "process_sort_index",
+                       "pid": pid, "tid": 0,
+                       "args": {"sort_index": i}})
+        for ev in doc.get("traceEvents", []):
+            ev = dict(ev)
+            ev["pid"] = pid
+            if "ts" in ev:
+                ev["ts"] = round(float(ev["ts"]) + shift_us, 3)
+            if ev.get("cat") == "flow" and "id" in ev:
+                # keep intra-doc flow pairs bound, but namespace them
+                # per doc so two replicas' span ids cannot collide
+                ev["id"] = f"p{pid}.{ev['id']}"
+            events.append(ev)
+            tid = (ev.get("args") or {}).get("trace")
+            if ev.get("ph") == "X" and tid:
+                t0 = float(ev["ts"])
+                t1 = t0 + float(ev.get("dur", 0.0))
+                legs.setdefault(str(tid), {}).setdefault(
+                    i, []).append((t0, t1, ev))
+    flow_ids = itertools.count(1)
+    for trace_id, by_doc in sorted(legs.items()):
+        groups = sorted(by_doc.values(),
+                        key=lambda g: min(t0 for t0, _, _ in g))
+        for prev, nxt in zip(groups, groups[1:]):
+            _, src_end, src = max(prev, key=lambda g: g[1])
+            dst_start, _, dst = min(nxt, key=lambda g: g[0])
+            fid = f"trace.{trace_id}.{next(flow_ids)}"
+            events.append({
+                "ph": "s", "id": fid, "name": "trace-leg",
+                "cat": "flow", "pid": src["pid"], "tid": src["tid"],
+                "ts": round(src_end, 3)})
+            events.append({
+                "ph": "f", "bp": "e", "id": fid, "name": "trace-leg",
+                "cat": "flow", "pid": dst["pid"], "tid": dst["tid"],
+                "ts": round(dst_start, 3)})
+    doc = {"traceEvents": events, "displayTimeUnit": "ms",
+           "otherData": {"unix_time_origin_s": base,
+                         "exporter": "deeplearning4j_tpu",
+                         "merged_docs": len(loaded)}}
+    if path:
+        with open(path, "w") as f:
+            json.dump(doc, f)
+    return doc

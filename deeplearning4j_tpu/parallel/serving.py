@@ -261,6 +261,13 @@ class ModelServer:
             self._attach_journal(name, engine)
         self.tracer = tracer if tracer is not None \
             else getattr(self._default_pi(), "tracer", None)
+        # engines without their own tracer inherit the server's: the
+        # server-side rpc.generate span and the engine's generation
+        # span tree land in ONE buffer (one export) per process
+        if self.tracer is not None:
+            for engine in self.decode_engines.values():
+                if getattr(engine, "tracer", None) is None:
+                    engine.tracer = self.tracer
         self.labels = labels
         self.host = host
         self.port = port
@@ -382,6 +389,9 @@ class ModelServer:
         set, the engine also gets its per-model-version write-ahead
         journal (recovery included)."""
         self.decode_engines[name] = engine
+        if self.tracer is not None \
+                and getattr(engine, "tracer", None) is None:
+            engine.tracer = self.tracer
         self._attach_journal(name, engine)
         return self
 
@@ -431,10 +441,35 @@ class ModelServer:
                 resume = [int(t) for t in np.asarray(resume).ravel()]
             rid = req.get("request_id")
             rid = None if rid is None else str(rid)
+            trace = req.get("trace")
+            trace = None if trace is None else str(trace)
         except (TypeError, ValueError) as e:
             raise _ClientError(f"bad generate parameters: {e}") \
                 from None
         tenant = tenant or req.get("tenant")
+        if self.tracer is None:
+            return self._run_generation(
+                engine, name, prompt, max_new, eos_id, timeout_s,
+                deadline_s, resume, rid, tenant, trace)
+        if trace is None:
+            from deeplearning4j_tpu.observability.tracing import (
+                new_trace_id,
+            )
+
+            trace = new_trace_id()
+        # the replica-side request span: the engine's "generate" root
+        # span (opened by submit on this thread) nests under it via the
+        # tracer's implicit stack, so one process's leg is one subtree
+        with self.tracer.span("rpc.generate", cat="serving",
+                              args={"trace": trace, "model": name,
+                                    "request_id": rid or ""}):
+            return self._run_generation(
+                engine, name, prompt, max_new, eos_id, timeout_s,
+                deadline_s, resume, rid, tenant, trace)
+
+    def _run_generation(self, engine, name, prompt, max_new, eos_id,
+                        timeout_s, deadline_s, resume, rid, tenant,
+                        trace) -> dict:
         if not engine.running:
             if not self._ready:
                 # retiring replica: never restart a decode loop the
@@ -450,7 +485,7 @@ class ModelServer:
             handle = engine.submit(prompt, max_new, eos_id=eos_id,
                                    tenant=tenant, deadline_s=deadline_s,
                                    resume_tokens=resume,
-                                   request_id=rid)
+                                   request_id=rid, trace=trace)
         except ValueError as e:
             raise _ClientError(str(e)) from None
         try:
@@ -460,10 +495,12 @@ class ModelServer:
             # tokens decoded so far plus a `resumable` marker, so the
             # caller (ModelClient / ReplicaRouter) can re-dispatch the
             # request to a healthy replica as a continuation instead of
-            # losing the work
+            # losing the work (the trace id rides along, so the next
+            # leg joins the same timeline)
             e.partial = {"tokens": handle.tokens_so_far(),
                          "finish_reason": "migrated",
-                         "model": name, "resumable": True}
+                         "model": name, "resumable": True,
+                         "trace": handle.trace}
             raise
         except TimeoutError:
             # transport-level wait budget, distinct from the engine's
@@ -474,7 +511,8 @@ class ModelServer:
                 f"generation exceeded timeout_s={timeout_s}")
             err.partial = {"tokens": handle.tokens_so_far(),
                            "finish_reason": "timeout",
-                           "model": name, "resumable": True}
+                           "model": name, "resumable": True,
+                           "trace": handle.trace}
             raise err from None
         return {
             "tokens": handle.tokens_so_far(),
@@ -483,6 +521,7 @@ class ModelServer:
             "evictions": handle.evictions,
             "replays": handle.replays,
             "request_id": handle.request_id,
+            "trace": handle.trace,
         }
 
     # ------------------------------------------------- lifecycle routes
@@ -1100,7 +1139,8 @@ class ModelClient:
                  deadline_s: Optional[float] = None,
                  resume_tokens=None,
                  max_resumes: int = 3,
-                 request_id: Optional[str] = None) -> dict:
+                 request_id: Optional[str] = None,
+                 trace: Optional[str] = None) -> dict:
         """POST /v1/models/<model>/generate — continuous-batched
         autoregressive generation. Returns {"tokens": [int, ...],
         "finish_reason": "eos"|"length"|"deadline", ...}; the token
@@ -1128,6 +1168,7 @@ class ModelClient:
         resume = ([int(t) for t in np.asarray(resume_tokens).ravel()]
                   if resume_tokens is not None else [])
         rid = str(request_id) if request_id else uuid.uuid4().hex
+        trace = str(trace) if trace else None
         last: Optional[Exception] = None
         for _ in range(max(0, int(max_resumes)) + 1):
             try:
@@ -1135,7 +1176,8 @@ class ModelClient:
                     prompt, max_new_tokens, eos_id=eos_id, model=model,
                     tenant=tenant, timeout_s=timeout_s,
                     deadline_s=deadline_s,
-                    resume_tokens=resume or None, request_id=rid)
+                    resume_tokens=resume or None, request_id=rid,
+                    trace=trace)
             except (ServingError, RetriesExhaustedError) as e:
                 partial = self._resumable_partial(e)
                 if partial is None:
@@ -1147,6 +1189,11 @@ class ModelClient:
                 got = partial.get("tokens") or []
                 if len(got) > len(resume):
                     resume = [int(t) for t in got]
+                # a server that minted the trace id reports it in the
+                # partial body — carry it into the next leg so the
+                # continuation joins the same timeline
+                if trace is None and partial.get("trace"):
+                    trace = str(partial["trace"])
         raise last
 
     @staticmethod
@@ -1169,12 +1216,15 @@ class ModelClient:
                        timeout_s: Optional[float],
                        deadline_s: Optional[float],
                        resume_tokens: Optional[list],
-                       request_id: Optional[str] = None) -> dict:
+                       request_id: Optional[str] = None,
+                       trace: Optional[str] = None) -> dict:
         model = model or "default"
         route = f"/v1/models/{model}/generate"
         meta = {"max_new_tokens": int(max_new_tokens)}
         if request_id is not None:
             meta["request_id"] = str(request_id)
+        if trace is not None:
+            meta["trace"] = str(trace)
         if eos_id is not None:
             meta["eos_id"] = int(eos_id)
         if tenant is not None:

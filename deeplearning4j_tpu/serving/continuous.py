@@ -127,11 +127,22 @@ from deeplearning4j_tpu.resilience.errors import (
     ShutdownError,
 )
 from deeplearning4j_tpu.resilience.faults import fire as _fire
+from deeplearning4j_tpu.serving.flight import FlightRecorder
 
 # every engine constructed in this process (weak — dead engines drop
 # out); tests/conftest.py reaps whatever a failed chaos test left
 # running so no loop/watchdog thread leaks into later tier-1 tests
 _LIVE_ENGINES: "weakref.WeakSet[DecodeEngine]" = weakref.WeakSet()
+
+
+def _ring_quantile(ring, q: float) -> Optional[float]:
+    """Exact quantile over a bounded ring of recent observations (the
+    window IS the estimator — same discipline as _Hist.quantile)."""
+    vals = sorted(ring)
+    if not vals:
+        return None
+    idx = min(len(vals) - 1, max(0, int(q * len(vals))))
+    return vals[idx]
 
 
 def reap_stray_engines() -> None:
@@ -158,16 +169,31 @@ class GenerationHandle:
                  eos_id: Optional[int],
                  deadline_s: Optional[float] = None,
                  request_id: Optional[str] = None,
-                 tenant: Optional[str] = None):
+                 tenant: Optional[str] = None,
+                 trace: Optional[str] = None):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.eos_id = eos_id
         self.request_id = request_id
         self.tenant = tenant
+        self.trace = trace
         self.finish_reason: Optional[str] = None
         self.evictions = 0
         self.replays = 0
         self.poison_strikes = 0
+        # latency-attribution clock marks (perf_counter values, set by
+        # the engine): submit -> first placement -> first/last emitted
+        # token. TTFT = first_token - submit, ITL = successive token
+        # gaps, queue wait = placed - submit; a resumed continuation
+        # restarts the marks on its new engine, so attribution is
+        # per-leg, never cross-process clock arithmetic
+        self.t_submit = time.perf_counter()
+        self.t_placed: Optional[float] = None
+        self.t_first_token: Optional[float] = None
+        self.t_last_token: Optional[float] = None
+        # root span of this leg's span tree (engine-owned; None when
+        # the engine has no tracer — the default-off zero-cost path)
+        self._span = None
         self._deadline = (time.monotonic() + float(deadline_s)
                           if deadline_s is not None else None)
         self._cancel_requested = False
@@ -503,7 +529,9 @@ class DecodeEngine:
                  poison_strike_limit: int = 2,
                  n_pages: Optional[int] = None,
                  prefix_cache: bool = True,
-                 journal=None):
+                 journal=None, tracer=None,
+                 flight_dir: Optional[str] = None,
+                 flight_capacity: int = 512):
         from deeplearning4j_tpu.engine.decode_program import (
             DecodeProgram,
         )
@@ -604,6 +632,23 @@ class DecodeEngine:
         # journal events collected under the step lock, written after
         # it (file I/O is never a step-lock holder)
         self._jevents: List[tuple] = []
+        # ---- tracing + latency attribution + flight recorder ----
+        # `tracer=None` is the zero-cost default: every span/record
+        # site is gated on it. Latency events (queue wait, TTFT, ITL,
+        # prefill chunks, span ends) ride the _jevents pattern: cheap
+        # tuples collected under the step lock, metrics/spans emitted
+        # after it.
+        self.tracer = tracer
+        self._lat: List[tuple] = []
+        self._ttft_ring: deque = deque(maxlen=512)
+        self._itl_ring: deque = deque(maxlen=512)
+        self._queue_ring: deque = deque(maxlen=512)
+        self._flight = FlightRecorder(capacity=flight_capacity,
+                                      dump_dir=flight_dir,
+                                      name=model_name)
+        # dump reason flagged under the step lock, dumped after it
+        # (the dump does file I/O — never a step-lock holder)
+        self._flight_dump_reason: Optional[str] = None
         _LIVE_ENGINES.add(self)
         if journal is not None:
             self.attach_journal(journal)
@@ -664,9 +709,12 @@ class DecodeEngine:
         err = ShutdownError("decode engine stopped")
         for handle, _ in pending:
             handle._finish(None, error=err)
+            self._end_span(handle, "shutdown")
         for s in range(self.max_slots):
             if self._active[s] and self._slot_req[s] is not None:
-                self._slot_req[s]._finish(None, error=err)
+                handle = self._slot_req[s]
+                handle._finish(None, error=err)
+                self._end_span(handle, "shutdown")
                 self._free_slot(s)
 
     def _loop(self, epoch: int) -> None:
@@ -758,11 +806,17 @@ class DecodeEngine:
                 self._step_lock.release()
             else:
                 self._step_lock = threading.Lock()
+        self._flight.note("restart", self._steps,
+                          reason=str(reason)[:120],
+                          exhausted=exhausted)
+        self._flight.dump("restart")
         if err is not None:
             for handle, _ in live:
                 handle._finish(None, error=err)
+                self._end_span(handle, "restarts_exhausted")
             for handle, _ in pending:
                 handle._finish(None, error=err)
+                self._end_span(handle, "restarts_exhausted")
             return
         with self._cond:
             self._pending.extend(pending)
@@ -781,7 +835,8 @@ class DecodeEngine:
                tenant: Optional[str] = None,
                deadline_s: Optional[float] = None,
                resume_tokens: Optional[Sequence[int]] = None,
-               request_id: Optional[str] = None
+               request_id: Optional[str] = None,
+               trace: Optional[str] = None
                ) -> GenerationHandle:
         """Admit one generation request (non-blocking). Raises
         QuotaExceededError (HTTP 429 + Retry-After) on tenant quota /
@@ -804,7 +859,13 @@ class DecodeEngine:
         the journal) returns the ORIGINAL handle — nothing is
         double-journaled or double-executed. With a journal attached,
         the admitted record is written BEFORE the request becomes
-        visible to the step loop (write-ahead)."""
+        visible to the step loop (write-ahead).
+
+        `trace` is the request's cross-process trace id (rode the wire
+        meta next to request_id). It is journaled with the admitted
+        record so a cold-restart recovery leg carries the original id;
+        with a tracer attached and no id supplied, the engine mints
+        one."""
         prompt = [int(t) for t in np.asarray(prompt, np.int64).ravel()]
         if not prompt:
             raise ValueError("prompt must carry at least one token")
@@ -832,9 +893,27 @@ class DecodeEngine:
             existing = self._handles_by_id.get(rid)
         if existing is not None and not existing.failed:
             return existing
+        tid = str(trace) if trace else None
+        if tid is None and self.tracer is not None:
+            from deeplearning4j_tpu.observability.tracing import (
+                new_trace_id,
+            )
+
+            tid = new_trace_id()
         handle = GenerationHandle(prompt, max_new_tokens, eos_id,
                                   deadline_s=deadline_s,
-                                  request_id=rid, tenant=tenant)
+                                  request_id=rid, tenant=tenant,
+                                  trace=tid)
+        if self.tracer is not None:
+            # the leg's root span: opened on the submitting thread (an
+            # enclosing server span parents it implicitly), closed by
+            # the post-step-lock drain when the stream finishes
+            handle._span = self.tracer.begin(
+                "generate", cat="decode",
+                args={"trace": tid, "request_id": rid,
+                      "tenant": tenant or "default",
+                      "model": self.model_name,
+                      "resumed": bool(resume)})
         if resume:
             handle._preload(resume)
             handle.replays += 1
@@ -846,6 +925,7 @@ class DecodeEngine:
                 finished = "length"
             if finished is not None:
                 handle._finish(finished)
+                self._end_span(handle, finished)
                 with self._cond:
                     cur = self._handles_by_id.get(rid)
                     if cur is None or cur.failed:
@@ -853,7 +933,8 @@ class DecodeEngine:
                 self._journal_safe(
                     lambda: self._journal.append_admitted(
                         rid, prompt, max_new_tokens, eos_id=eos_id,
-                        tenant=tenant, deadline_s=deadline_s))
+                        tenant=tenant, deadline_s=deadline_s,
+                        trace=handle.trace))
                 self._journal_safe(
                     lambda: self._journal.record_progress(rid, resume))
                 self._journal_safe(
@@ -870,7 +951,7 @@ class DecodeEngine:
         # shed below appends done("shed") so the journal stays clean
         self._journal_safe(lambda: self._journal.append_admitted(
             rid, prompt, max_new_tokens, eos_id=eos_id, tenant=tenant,
-            deadline_s=deadline_s))
+            deadline_s=deadline_s, trace=handle.trace))
         if resume:
             self._journal_safe(
                 lambda: self._journal.record_progress(rid, resume))
@@ -889,6 +970,7 @@ class DecodeEngine:
         if shed:
             self._journal_safe(
                 lambda: self._journal.append_done(rid, "shed"))
+            self._end_span(handle, "shed")
             raise QuotaExceededError(
                 f"decode slots exhausted ({self.max_slots} resident, "
                 f"{self.queue_limit} waiting)", tenant=tenant or "",
@@ -935,12 +1017,16 @@ class DecodeEngine:
         for rid in sorted(live):
             req = live[rid]
             try:
+                # the journaled trace id rides into the recovery leg,
+                # so the cold-restart continuation merges into the
+                # request's original timeline
                 self.submit(req["prompt"], req["max_new_tokens"],
                             eos_id=req.get("eos_id"),
                             tenant=req.get("tenant"),
                             deadline_s=req.get("deadline_s"),
                             resume_tokens=req.get("tokens") or None,
-                            request_id=rid)
+                            request_id=rid,
+                            trace=req.get("trace"))
                 recovered += 1
             except (ValueError, QuotaExceededError):
                 journal.append_done(rid, "unrecoverable")
@@ -960,6 +1046,58 @@ class DecodeEngine:
             fn()
         except Exception:  # noqa — durability degrades, serving continues; journal failures must not poison the data plane
             pass
+
+    def _end_span(self, handle: GenerationHandle,
+                  reason: str) -> None:
+        """Close a handle's leg-root span (no-op without a tracer).
+        Only ever called OUTSIDE the step lock — span completion takes
+        the tracer lock and may flush."""
+        sp = handle._span
+        if sp is not None:
+            sp.end(finish_reason=reason)
+
+    def _emit_latency(self, lat: List[tuple]) -> None:
+        """Drain one step's latency events OUTSIDE the step lock:
+        TTFT/ITL/queue-wait histogram observations (labeled by tenant
+        class) plus — with a tracer attached — the matching span
+        records (`Tracer.record` over the pre-measured intervals; no
+        span objects ever exist on the locked path)."""
+        tracer = self.tracer
+        for kind, handle, a, b in lat:
+            tenant = handle.tenant or "default"
+            targs = None
+            if tracer is not None:
+                targs = {"trace": handle.trace,
+                         "request_id": handle.request_id}
+            if kind == "queue_wait":
+                self._queue_ring.append(b - a)
+                _obs.observe("dl4j_decode_queue_wait_seconds", b - a,
+                             labels={"tenant": tenant})
+                if tracer is not None:
+                    tracer.record("admission_wait", a, b, cat="decode",
+                                  parent=handle._span, args=targs)
+            elif kind == "ttft":
+                dt = b - handle.t_submit
+                self._ttft_ring.append(dt)
+                _obs.observe("dl4j_decode_ttft_seconds", dt,
+                             labels={"tenant": tenant})
+                if tracer is not None:
+                    targs["first"] = True
+                    tracer.record("token", a, b, cat="decode",
+                                  parent=handle._span, args=targs)
+            elif kind == "itl":
+                self._itl_ring.append(b - a)
+                _obs.observe("dl4j_decode_itl_seconds", b - a,
+                             labels={"tenant": tenant})
+                if tracer is not None:
+                    tracer.record("token", a, b, cat="decode",
+                                  parent=handle._span, args=targs)
+            elif kind == "chunk":
+                if tracer is not None:
+                    tracer.record("prefill_chunk", a, b, cat="decode",
+                                  parent=handle._span, args=targs)
+            elif kind == "end":
+                self._end_span(handle, a)
 
     def _note_done_id(self, rid: Optional[str]) -> None:
         """Bounded retention for finished idempotency keys: keep the
@@ -1062,6 +1200,9 @@ class DecodeEngine:
                 self._quarantine_poisoned(ok_host, decoding)
                 emitted += self._harvest(nxt_host, decoding)
             jevents, self._jevents = self._jevents, []
+            lat, self._lat = self._lat, []
+            dump_reason, self._flight_dump_reason = (
+                self._flight_dump_reason, None)
         chunks = self._prefill_chunks - chunks_before
         if chunks:
             _obs.count("dl4j_decode_prefill_chunks_total", n=chunks)
@@ -1086,6 +1227,9 @@ class DecodeEngine:
             _obs.observe("dl4j_decode_prefill_seconds", dt)
         if emitted:
             _obs.count("dl4j_decode_tokens_total", n=emitted)
+        self._emit_latency(lat)
+        if dump_reason is not None:
+            self._flight.dump(dump_reason)
         self._publish_gauges()
         self._write_journal(jevents)
         return bool(stepped or admitted or chunks or evicted
@@ -1115,6 +1259,8 @@ class DecodeEngine:
                         continue
                     handle._finish(reason)
                     self._jevents.append(("done", handle, reason))
+                    if self.tracer is not None:
+                        self._lat.append(("end", handle, reason, None))
                     n_deadline += reason == "deadline"
                     n_cancel += reason == "cancelled"
                 self._pending = kept
@@ -1127,6 +1273,10 @@ class DecodeEngine:
             handle = self._slot_req[s]
             handle._finish(reason)
             self._jevents.append(("done", handle, reason))
+            if self.tracer is not None:
+                self._lat.append(("end", handle, reason, None))
+            self._flight.note("leave", self._steps, slot=s,
+                              reason=reason)
             self._free_slot(s)
             n_deadline += reason == "deadline"
             n_cancel += reason == "cancelled"
@@ -1186,6 +1336,14 @@ class DecodeEngine:
         self._slot_req[slot] = handle
         self._active[slot] = True
         self._slot_replay[slot] = deque(replay) if replay else None
+        if handle.t_placed is None:
+            # first placement only: a re-placement after eviction is
+            # recovery churn, not admission wait
+            handle.t_placed = time.perf_counter()
+            self._lat.append(("queue_wait", handle, handle.t_submit,
+                              handle.t_placed))
+        self._flight.note("join", self._steps, slot=slot,
+                          req=handle.request_id, replay=bool(replay))
         if replay:
             # forced replay: the recorded token stream IS the truth
             # (greedy decode would regenerate it; forcing makes the
@@ -1227,7 +1385,11 @@ class DecodeEngine:
         self.kv = self.program.prefill_chunk(
             self.kv, prompt[start:start + ps], start, cp, co, page)
         self._prefill_chunks += 1
-        prefill_s.append(time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        prefill_s.append(t1 - t0)
+        if self.tracer is not None:
+            self._lat.append(("chunk", handle, t0, t1))
+        self._flight.note("chunk", self._steps, slot=slot, start=start)
         nxt = start + ps
         if nxt >= len(prompt):
             self._fill_next[slot] = -1
@@ -1353,6 +1515,10 @@ class DecodeEngine:
     def _harvest(self, nxt_host: np.ndarray,
                  decoding: np.ndarray) -> int:
         emitted = 0
+        # one clock read per step: every slot's token materialized in
+        # the same dispatch, so they share a timestamp (TTFT/ITL marks
+        # are tuples into _lat — emission happens after the step lock)
+        now = time.perf_counter()
         for s in range(self.max_slots):
             if not decoding[s] or not self._active[s]:
                 continue
@@ -1370,6 +1536,16 @@ class DecodeEngine:
             handle = self._slot_req[s]
             handle._append(tok)
             self._jevents.append(("progress", handle))
+            if handle.t_first_token is None:
+                handle.t_first_token = now
+                self._lat.append((
+                    "ttft", handle,
+                    (handle.t_placed if handle.t_placed is not None
+                     else handle.t_submit), now))
+            else:
+                self._lat.append(("itl", handle,
+                                  handle.t_last_token, now))
+            handle.t_last_token = now
             emitted += 1
             self._tokens_emitted += 1
             self._maybe_finish(s, tok)
@@ -1385,6 +1561,10 @@ class DecodeEngine:
             return
         handle._finish(reason)
         self._jevents.append(("done", handle, reason))
+        if self.tracer is not None:
+            self._lat.append(("end", handle, reason, None))
+        self._flight.note("leave", self._steps, slot=slot,
+                          reason=reason)
         self._free_slot(slot)
         self._completed += 1
 
@@ -1412,6 +1592,8 @@ class DecodeEngine:
         output; nothing is emitted twice."""
         handle = self._slot_req[s]
         recorded = handle.tokens_so_far()
+        self._flight.note("evict", self._steps, slot=s,
+                          req=handle.request_id)
         self._free_slot(s)
         handle.evictions += 1
         self._evictions += 1
@@ -1467,6 +1649,10 @@ class DecodeEngine:
             self._free_slot(s)
             self._quarantined[s] = True
             self._quarantines += 1
+            self._flight.note("quarantine", self._steps, slot=s,
+                              req=handle.request_id,
+                              strikes=handle.poison_strikes + 1)
+            self._flight_dump_reason = "quarantine"
             handle.poison_strikes += 1
             if handle.poison_strikes > self.poison_strike_limit:
                 handle._finish(None, error=GenerationPoisonedError(
@@ -1476,6 +1662,9 @@ class DecodeEngine:
                     model=self.model_name,
                     strikes=handle.poison_strikes))
                 self._jevents.append(("done", handle, "poisoned"))
+                if self.tracer is not None:
+                    self._lat.append(("end", handle, "poisoned",
+                                      None))
                 continue
             with self._cond:
                 self._pending.appendleft((handle, recorded or None))
@@ -1495,6 +1684,19 @@ class DecodeEngine:
     def tokens_per_s(self) -> float:
         return self._tokens_emitted / max(time.monotonic() - self._t0,
                                           1e-9)
+
+    def latency_stats(self) -> Dict:
+        """Per-engine latency attribution over the recent-observation
+        rings (p50/p99 — the /status decode facts; the fleet-wide
+        histograms live in the metrics registry)."""
+        return {
+            "ttft_p50_s": _ring_quantile(self._ttft_ring, 0.5),
+            "ttft_p99_s": _ring_quantile(self._ttft_ring, 0.99),
+            "itl_p50_s": _ring_quantile(self._itl_ring, 0.5),
+            "itl_p99_s": _ring_quantile(self._itl_ring, 0.99),
+            "queue_wait_p50_s": _ring_quantile(self._queue_ring, 0.5),
+            "queue_wait_p99_s": _ring_quantile(self._queue_ring, 0.99),
+        }
 
     def stats(self) -> Dict:
         with self._cond:
@@ -1533,6 +1735,11 @@ class DecodeEngine:
             "engine_restarts": self._restarts,
             "tokens_per_s": round(self.tokens_per_s(), 3),
             "trace_counts": self.program.trace_stats()["trace_counts"],
+            "dispatches": self.program.trace_stats().get("dispatches"),
+            "latency": self.latency_stats(),
+            "flight": self._flight.stats(),
+            "tracing": (self.tracer.stats()
+                        if self.tracer is not None else None),
             "journal": (dict(self._journal.stats(),
                              recovered=self._recovered)
                         if self._journal is not None else None),

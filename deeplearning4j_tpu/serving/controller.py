@@ -99,6 +99,7 @@ class SLOPolicy:
       max_error_rate   5xx fraction of requests per window
       max_p99_s        absolute p99 latency bound
       max_p99_ratio    p99 vs. the pre-flip baseline window
+      max_ttft_p99_s   absolute decode time-to-first-token p99 bound
 
     Watch shape:
       window_s       one observation window (snapshot delta)
@@ -111,17 +112,20 @@ class SLOPolicy:
     Grammar (the README "Fleet control" section documents it):
 
         SLOPolicy.parse("error_rate<0.02,p99<250ms,p99_ratio<1.5,"
+                        "ttft_p99<100ms,"
                         "min_requests=20,window=500ms,windows=3")
     """
 
     def __init__(self, max_error_rate: Optional[float] = 0.02,
                  max_p99_s: Optional[float] = None,
                  max_p99_ratio: Optional[float] = None,
+                 max_ttft_p99_s: Optional[float] = None,
                  min_requests: int = 10, window_s: float = 1.0,
                  windows: int = 3, ramp_windows: int = 1):
         self.max_error_rate = max_error_rate
         self.max_p99_s = max_p99_s
         self.max_p99_ratio = max_p99_ratio
+        self.max_ttft_p99_s = max_ttft_p99_s
         self.min_requests = int(min_requests)
         self.window_s = float(window_s)
         self.windows = int(windows)
@@ -146,6 +150,8 @@ class SLOPolicy:
                 kw["max_p99_s"] = _parse_duration_s(val)
             elif key == "p99_ratio":
                 kw["max_p99_ratio"] = float(val)
+            elif key == "ttft_p99":
+                kw["max_ttft_p99_s"] = _parse_duration_s(val)
             elif key == "min_requests":
                 kw["min_requests"] = int(val)
             elif key == "window":
@@ -166,6 +172,8 @@ class SLOPolicy:
             parts.append(f"p99<{self.max_p99_s * 1e3:g}ms")
         if self.max_p99_ratio is not None:
             parts.append(f"p99_ratio<{self.max_p99_ratio:g}")
+        if self.max_ttft_p99_s is not None:
+            parts.append(f"ttft_p99<{self.max_ttft_p99_s * 1e3:g}ms")
         parts += [f"min_requests={self.min_requests}",
                   f"window={self.window_s:g}s",
                   f"windows={self.windows}",
@@ -195,6 +203,11 @@ class SLOPolicy:
                 return (f"p99 {p99 * 1e3:.1f}ms > "
                         f"{self.max_p99_ratio:g}x baseline "
                         f"{baseline_p99_s * 1e3:.1f}ms")
+        ttft = sample.get("ttft_p99_s")
+        if self.max_ttft_p99_s is not None and ttft is not None \
+                and ttft > self.max_ttft_p99_s:
+            return (f"ttft_p99 {ttft * 1e3:.1f}ms > "
+                    f"{self.max_ttft_p99_s * 1e3:g}ms")
         return None
 
 
@@ -236,34 +249,45 @@ def _bucket_upper(le: str) -> float:
     return float("inf") if le == "+Inf" else float(le)
 
 
-def slo_sample(prev: dict, cur: dict,
-               hist: str = "dl4j_serving_request_seconds") -> dict:
-    """Error-rate + p99 between two metric snapshots (the one watch
-    window). p99 is read from the histogram BUCKET deltas — an upper
-    bound at bucket resolution, which is exactly what an SLO bound
-    wants (never under-reports a breach)."""
-    req = (_counter_total(cur, "dl4j_serving_requests_total")
-           - _counter_total(prev, "dl4j_serving_requests_total"))
-    err = _error_total(cur) - _error_total(prev)
+def _hist_p99_delta(prev: dict, cur: dict,
+                    hist: str) -> Optional[float]:
+    """p99 of one histogram family between two snapshots, read from
+    the BUCKET deltas — an upper bound at bucket resolution, which is
+    exactly what an SLO bound wants (never under-reports a breach).
+    None when the window saw no observations."""
     c0, b0 = _hist_series(prev, hist)
     c1, b1 = _hist_series(cur, hist)
     dcount = c1 - c0
-    p99 = None
-    if dcount > 0:
-        deltas = sorted(
-            ((le, b1.get(le, 0) - b0.get(le, 0))
-             for le in b1), key=lambda kv: _bucket_upper(kv[0]))
-        cum, target = 0, 0.99 * dcount
-        for le, c in deltas:
-            cum += c
-            if cum >= target:
-                p99 = _bucket_upper(le)
-                break
+    if dcount <= 0:
+        return None
+    deltas = sorted(
+        ((le, b1.get(le, 0) - b0.get(le, 0))
+         for le in b1), key=lambda kv: _bucket_upper(kv[0]))
+    cum, target = 0, 0.99 * dcount
+    for le, c in deltas:
+        cum += c
+        if cum >= target:
+            return _bucket_upper(le)
+    return None
+
+
+def slo_sample(prev: dict, cur: dict,
+               hist: str = "dl4j_serving_request_seconds") -> dict:
+    """Error-rate + latency p99s between two metric snapshots (the one
+    watch window). `p99_s` is end-to-end request latency;
+    `ttft_p99_s` is decode time-to-first-token (the user-visible
+    responsiveness bound rollout policies gate on via `ttft_p99<...`).
+    Both come from histogram bucket deltas via `_hist_p99_delta`."""
+    req = (_counter_total(cur, "dl4j_serving_requests_total")
+           - _counter_total(prev, "dl4j_serving_requests_total"))
+    err = _error_total(cur) - _error_total(prev)
+    p99 = _hist_p99_delta(prev, cur, hist)
+    ttft_p99 = _hist_p99_delta(prev, cur, "dl4j_decode_ttft_seconds")
     mfu_series = cur.get("gauges", {}).get("dl4j_perf_mfu") or {}
     mfu = list(mfu_series.values())[-1] if mfu_series else None
     return {"requests": req, "errors": err,
             "error_rate": (err / req) if req > 0 else 0.0,
-            "p99_s": p99, "mfu": mfu}
+            "p99_s": p99, "ttft_p99_s": ttft_p99, "mfu": mfu}
 
 
 # ------------------------------------------------------ replica handles

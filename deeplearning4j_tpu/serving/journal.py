@@ -318,6 +318,7 @@ class GenerationJournal:
                     "eos_id": rec.get("eos_id"),
                     "tenant": rec.get("tenant"),
                     "deadline_s": rec.get("deadline_s"),
+                    "trace": rec.get("trace"),
                     "tokens": [],
                     "done": False,
                     "finish_reason": None,
@@ -343,9 +344,12 @@ class GenerationJournal:
     def append_admitted(self, rid, prompt, max_new_tokens,
                         eos_id: Optional[int] = None,
                         tenant: Optional[str] = None,
-                        deadline_s: Optional[float] = None) -> None:
+                        deadline_s: Optional[float] = None,
+                        trace: Optional[str] = None) -> None:
         """Journal a request's admission. Idempotent on `rid`: a client
-        retry (or a racing duplicate submit) appends nothing."""
+        retry (or a racing duplicate submit) appends nothing. `trace`
+        is the request's cross-process trace id — journaled so a
+        cold-restart recovery leg rejoins the original timeline."""
         rid = str(rid)
         rec = {"kind": "admitted", "id": rid,
                "prompt": [int(t) for t in prompt],
@@ -356,6 +360,8 @@ class GenerationJournal:
             rec["tenant"] = str(tenant)
         if deadline_s is not None:
             rec["deadline_s"] = float(deadline_s)
+        if trace is not None:
+            rec["trace"] = str(trace)
         with self._io_lock:
             if rid not in self._requests:
                 self._replay(rec)
@@ -494,6 +500,8 @@ class GenerationJournal:
                 rec["tenant"] = req["tenant"]
             if req["deadline_s"] is not None:
                 rec["deadline_s"] = req["deadline_s"]
+            if req.get("trace") is not None:
+                rec["trace"] = req["trace"]
             records.append(rec)
             if req["tokens"]:
                 records.append({"kind": "progress", "id": rid,
@@ -536,6 +544,7 @@ class GenerationJournal:
                           "eos_id": req["eos_id"],
                           "tenant": req["tenant"],
                           "deadline_s": req["deadline_s"],
+                          "trace": req.get("trace"),
                           "tokens": list(req["tokens"])}
                     for rid, req in self._requests.items()
                     if not req["done"]}

@@ -86,7 +86,8 @@ class ReplicaRouter:
     (or to stub replicas in tests)."""
 
     def __init__(self, urls: List[str], timeout: float = 30.0,
-                 client_factory: Optional[Callable] = None):
+                 client_factory: Optional[Callable] = None,
+                 tracer=None):
         if not urls:
             raise ValueError("ReplicaRouter needs at least one URL")
         self._factory = client_factory or _default_factory(timeout)
@@ -95,6 +96,10 @@ class ReplicaRouter:
         self._lock = threading.Lock()
         self._rr = 0
         self.failovers = 0
+        # optional observability.tracing.Tracer: when set, generate()
+        # opens a client-side root span and a per-leg span per replica
+        # attempt so the merged timeline shows the migration hops
+        self.tracer = tracer
 
     # ----------------------------------------------------- membership
     def urls(self) -> List[str]:
@@ -249,7 +254,8 @@ class ReplicaRouter:
                  timeout_s: Optional[float] = None,
                  deadline_s: Optional[float] = None,
                  resume_tokens: Optional[list] = None,
-                 request_id: Optional[str] = None) -> dict:
+                 request_id: Optional[str] = None,
+                 trace: Optional[str] = None) -> dict:
         """One logical generation over the fleet, with cross-replica
         MIGRATION: when the serving replica dies or retires
         mid-generation, its resumable 503 body (tokens decoded so far)
@@ -270,13 +276,50 @@ class ReplicaRouter:
         idempotency key for the whole logical request: every failover
         attempt carries it, so a replica that already journaled the
         stream — including one recovered from its journal after a
-        fleet-wide outage — joins it instead of double-executing."""
+        fleet-wide outage — joins it instead of double-executing.
+
+        `trace` rides the same road: ONE trace id for the whole
+        logical request, re-sent with every failover attempt, so the
+        legs a migrating generation leaves on different replicas merge
+        into a single timeline (observability.tracing
+        `merge_chrome_traces`). Minted here when the router has a
+        tracer and the caller supplied none."""
+        rid = str(request_id) if request_id else uuid.uuid4().hex
+        tid = str(trace) if trace else None
+        if self.tracer is not None:
+            if tid is None:
+                from deeplearning4j_tpu.observability.tracing import (
+                    new_trace_id,
+                )
+                tid = new_trace_id()
+            with self.tracer.span("client.generate", cat="client",
+                                  args={"trace": tid,
+                                        "request_id": rid}):
+                return self._generate_attempts(
+                    prompt, max_new_tokens, eos_id, model, tenant,
+                    timeout_s, deadline_s, resume_tokens, rid, tid)
+        return self._generate_attempts(
+            prompt, max_new_tokens, eos_id, model, tenant, timeout_s,
+            deadline_s, resume_tokens, rid, tid)
+
+    def _leg_span(self, tid, url, t0, ok: bool) -> None:
+        """One pre-measured `client.leg` span per replica attempt —
+        failed legs show on the timeline too (that's the point)."""
+        if self.tracer is None or tid is None:
+            return
+        self.tracer.record("client.leg", t0, time.perf_counter(),
+                           cat="client",
+                           args={"trace": tid, "replica": url,
+                                 "ok": ok})
+
+    def _generate_attempts(self, prompt, max_new_tokens, eos_id, model,
+                           tenant, timeout_s, deadline_s, resume_tokens,
+                           rid, tid) -> dict:
         tried: set = set()
         causes: list = []
         last: Optional[Exception] = None
         resume = ([int(t) for t in resume_tokens]
                   if resume_tokens else [])
-        rid = str(request_id) if request_id else uuid.uuid4().hex
         migrations = 0
         while True:
             r = self._pick(tried)
@@ -296,6 +339,7 @@ class ReplicaRouter:
             if continuation:
                 migrations += 1
                 _obs.count("dl4j_decode_migrations_total")
+            t_leg = time.perf_counter()
             try:
                 # max_resumes=0: migration is the ROUTER's job here —
                 # the client surfaces the resumable failure instead of
@@ -305,8 +349,9 @@ class ReplicaRouter:
                     tenant=tenant, timeout_s=timeout_s,
                     deadline_s=deadline_s,
                     resume_tokens=continuation or None, max_resumes=0,
-                    request_id=rid)
+                    request_id=rid, trace=tid)
             except _FAILOVER as exc:
+                self._leg_span(tid, r.url, t_leg, ok=False)
                 removed = not self._is_member(r)
                 self._release(r, failed=not removed)
                 partial = self._resumable_partial(exc)
@@ -322,6 +367,7 @@ class ReplicaRouter:
                     _obs.count("dl4j_serving_replica_failovers_total")
                 continue
             except ServingError as exc:
+                self._leg_span(tid, r.url, t_leg, ok=False)
                 removed = not self._is_member(r)
                 partial = self._resumable_partial(exc)
                 self._release(r, failed=exc.retryable and not removed)
@@ -338,8 +384,11 @@ class ReplicaRouter:
                         self.failovers += 1
                     _obs.count("dl4j_serving_replica_failovers_total")
                 continue
+            self._leg_span(tid, r.url, t_leg, ok=True)
             self._release(r, failed=False)
             out["migrations"] = migrations
+            if tid is not None:
+                out.setdefault("trace", tid)
             return out
         raise NoHealthyReplicaError(
             f"no healthy replica finished the generation "

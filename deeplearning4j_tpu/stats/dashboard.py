@@ -440,6 +440,36 @@ def telemetry_lines(snapshot) -> list:
         if pages_free is not None:
             dec.append(f"{int(pages_free)} pages free")
         lines.append("decode — " + " · ".join(dec))
+
+    # per-request latency attribution (TTFT / inter-token / queue-wait
+    # histograms, labeled by tenant): the worst label set is shown —
+    # an SLO eye wants the slowest tenant, not the average
+    def hquant(name, q):
+        worst = None
+        for key, h in hists.items():
+            if key != name and not key.startswith(name + "{"):
+                continue
+            v = h.get(q)
+            if v is not None and (worst is None or v > worst):
+                worst = v
+        return worst
+
+    ttft99 = hquant("dl4j_decode_ttft_seconds", "p99")
+    itl99 = hquant("dl4j_decode_itl_seconds", "p99")
+    if ttft99 is not None or itl99 is not None:
+        lat = []
+        if ttft99 is not None:
+            ttft50 = hquant("dl4j_decode_ttft_seconds", "p50")
+            lat.append(f"ttft p50 {(ttft50 or 0) * 1e3:.1f}ms "
+                       f"p99 {ttft99 * 1e3:.1f}ms")
+        if itl99 is not None:
+            itl50 = hquant("dl4j_decode_itl_seconds", "p50")
+            lat.append(f"itl p50 {(itl50 or 0) * 1e3:.1f}ms "
+                       f"p99 {itl99 * 1e3:.1f}ms")
+        qw99 = hquant("dl4j_decode_queue_wait_seconds", "p99")
+        if qw99 is not None:
+            lat.append(f"queue wait p99 {qw99 * 1e3:.1f}ms")
+        lines.append("decode latency — " + " · ".join(lat))
     # decode durability (quarantine / migration / watchdog restart /
     # deadline sweep) — shown once any of its counters has moved
     if any(k in c for k in ("dl4j_decode_slot_quarantines_total",

@@ -64,15 +64,26 @@ def pytest_sessionfinish(session, exitstatus):
 # main-thread tracing): a cyclic-GC pass landing mid-trace races
 # jax's weakref-keyed caches. Freeze the post-import heap (the ~190
 # extension modules are permanent; scanning them every collection is
-# pure risk) and raise gen0's threshold so collections are rare enough
-# to stop landing inside trace/dispatch windows. Memory is bounded by
-# the per-test fixtures; RSS stays far under this box's budget.
+# pure risk). Raising gen0's threshold only made mid-trace collections
+# RARE — on a loaded box they still landed inside pjit staging (crash
+# dumps at varying tests, always "Garbage-collecting" under
+# partial_eval). Automatic collection is now OFF entirely: the only
+# cyclic-GC passes are the explicit per-test ones below, on the main
+# thread after teardown, when any leaked worker thread is idle in a
+# queue wait rather than mid-trace. Memory stays bounded — every
+# test's cyclic garbage is collected at its own finish line.
 def pytest_sessionstart(session):
     import gc
 
     gc.collect()
     gc.freeze()
-    gc.set_threshold(50_000, 50, 50)
+    gc.disable()
+
+
+def pytest_runtest_logfinish(nodeid, location):
+    import gc
+
+    gc.collect()
 
 
 @pytest.fixture
@@ -158,6 +169,20 @@ def _reap_journals():
 
 
 @pytest.fixture(autouse=True)
+def _reap_flight_dumps():
+    """Chaos isolation for POSTMORTEMS: a quarantine/restart drill (or
+    an interrupted one) leaves flight-recorder dump files behind —
+    remove every dump written on this test's watch so no postmortem
+    litter leaks into later runs. Lazy, like the journal reaper."""
+    import sys as _sys
+
+    yield
+    mod = _sys.modules.get("deeplearning4j_tpu.serving.flight")
+    if mod is not None:
+        mod.reap_stray_flight_dumps()
+
+
+@pytest.fixture(autouse=True)
 def _clear_faults():
     """Chaos isolation: no armed fault may leak into the next test."""
     from deeplearning4j_tpu.resilience.faults import injector
@@ -206,16 +231,17 @@ def _lock_order_check(request):
 @pytest.fixture(autouse=True)
 def _restore_signal_handlers():
     """Chaos isolation for signals: preemption/watchdog tests install
-    SIGTERM/SIGINT/SIGUSR1 handlers (PreemptionHandler, StepWatchdog);
-    whatever a test leaves behind is restored so no handler leaks into
-    the next test. (SIGALRM is owned by _hang_guard above.)"""
+    SIGTERM/SIGINT/SIGUSR1/SIGUSR2 handlers (PreemptionHandler,
+    StepWatchdog, flight-recorder install_signal_dump); whatever a
+    test leaves behind is restored so no handler leaks into the next
+    test. (SIGALRM is owned by _hang_guard above.)"""
     import signal
     import threading
 
     if threading.current_thread() is not threading.main_thread():
         yield
         return
-    names = [n for n in ("SIGTERM", "SIGINT", "SIGUSR1")
+    names = [n for n in ("SIGTERM", "SIGINT", "SIGUSR1", "SIGUSR2")
              if hasattr(signal, n)]
     saved = {n: signal.getsignal(getattr(signal, n)) for n in names}
     yield
